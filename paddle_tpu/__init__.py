@@ -1,0 +1,40 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle
+Fluid's capabilities (reference: BrianZhu01/Paddle, surveyed in SURVEY.md),
+built on JAX/XLA/Pallas/pjit.
+
+Typical use mirrors Fluid:
+
+    import paddle_tpu as fluid
+
+    x = fluid.layers.data("x", shape=[784])
+    y = fluid.layers.data("y", shape=[1], dtype="int64")
+    out = fluid.layers.fc(x, size=10, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(out, y))
+    fluid.optimizer.Adam(1e-3).minimize(loss)
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+    loss_val, = exe.run(feed={"x": xb, "y": yb}, fetch_list=[loss])
+"""
+
+from . import backward, clip, initializer, layers, optimizer, regularizer  # noqa: F401
+from .backward import append_backward  # noqa: F401
+from .core.framework import (  # noqa: F401
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    name_scope,
+    program_guard,
+    test_mode,
+)
+from .core import unique_name  # noqa: F401
+from .core.place import CPUPlace, CUDAPinnedPlace, TPUPlace, is_compiled_with_tpu  # noqa: F401
+from .core.scope import Scope, global_scope, scope_guard  # noqa: F401
+from .executor import Executor  # noqa: F401
+from .layers.layer_helper import ParamAttr  # noqa: F401
+
+# Fluid compatibility: CUDAPlace maps to the accelerator (TPU) place.
+CUDAPlace = TPUPlace
+
+__version__ = "0.1.0"

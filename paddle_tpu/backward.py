@@ -1,0 +1,83 @@
+"""Static-graph autodiff API (reference: python/paddle/fluid/backward.py:394).
+
+Fluid's ``append_backward`` walks the forward ops in reverse and appends
+per-op grad ops built by C++ GradOpMakers. The TPU-native equivalent keeps
+the same API shape — it declares gradient variables (``p@GRAD``) and marks
+the program — but the actual differentiation is done by ``jax.grad`` over the
+traced forward function at compile time inside the Executor. That yields
+XLA-fused backward code instead of an interpreted grad-op list, while user
+code (optimizers reading ``param_to_grad``) is unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .core.framework import Parameter, Program, Variable, grad_var_name
+
+__all__ = ["append_backward", "gradients", "calc_gradient"]
+
+
+def _find_trainable_params(program: Program, parameter_list, no_grad_set) -> List[Parameter]:
+    if parameter_list:
+        names = set(p.name if isinstance(p, Variable) else p for p in parameter_list)
+        params = [p for p in program.all_parameters() if p.name in names]
+    else:
+        params = [p for p in program.all_parameters() if p.trainable]
+    if no_grad_set:
+        no_grad = set(v.name if isinstance(v, Variable) else v for v in no_grad_set)
+        params = [p for p in params if p.name not in no_grad]
+    return params
+
+
+def append_backward(
+    loss: Variable,
+    parameter_list: Optional[Sequence] = None,
+    no_grad_set: Optional[set] = None,
+    callbacks=None,
+) -> List[Tuple[Parameter, Variable]]:
+    """Mark the program for differentiation; returns [(param, grad_var), ...].
+
+    The returned grad vars are bound at execution: the Executor computes
+    ``jax.grad`` of the loss wrt each param and materializes the results
+    under the ``p@GRAD`` names, so downstream ops (optimizers, grad clip,
+    regularizers — which the Optimizer layer appends *after* the marker) see
+    exactly what Fluid's appended grad ops would have produced.
+    """
+    program = loss.block.program
+    block = program.global_block
+    if program._backward_info is not None:
+        raise RuntimeError("append_backward called twice on the same program")
+
+    params = _find_trainable_params(program, parameter_list, no_grad_set)
+    param_to_grad: Dict[str, str] = {}
+    param_grads: List[Tuple[Parameter, Variable]] = []
+    for p in params:
+        gname = grad_var_name(p.name)
+        gvar = block.create_var(name=gname, shape=p.shape, dtype=p.dtype, stop_gradient=True)
+        param_to_grad[p.name] = gname
+        param_grads.append((p, gvar))
+
+    loss_grad = block.create_var(
+        name=grad_var_name(loss.name), shape=loss.shape, dtype=loss.dtype, stop_gradient=True
+    )
+    block.append_op(
+        "backward_marker",
+        inputs={"Loss": loss},
+        outputs={"ParamGrads": [g for _, g in param_grads]},
+        attrs={"loss": loss.name, "param_to_grad": dict(param_to_grad)},
+    )
+    program._backward_info = {"loss": loss.name, "param_to_grad": param_to_grad}
+    return param_grads
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """jax.grad-backed replacement for fluid.gradients (backward.py:613)."""
+    raise NotImplementedError(
+        "gradients() for arbitrary targets is provided via Executor fetch of "
+        "@GRAD vars after append_backward; arbitrary-var grads land with the "
+        "inference/export milestone."
+    )
+
+
+calc_gradient = gradients

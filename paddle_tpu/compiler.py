@@ -1,0 +1,113 @@
+"""CompiledProgram — the multi-device front door.
+
+Reference: ``python/paddle/fluid/compiler.py:62`` + the C++ ParallelExecutor
+(``framework/parallel_executor.cc:184``). Fluid replicates the program per
+GPU, builds an SSA graph with NCCL AllReduce op handles, and schedules it
+with a threaded dataflow executor. The TPU-native design needs none of that
+machinery: the jitted step is compiled under a ``jax.sharding.Mesh`` with the
+feed batch sharded on the ``data`` axis and state replicated; XLA's GSPMD
+partitioner inserts the gradient ``psum`` over ICI automatically. Multi-host
+(the reference's NCCL2 mode) is the same code over a larger mesh after
+``jax.distributed.initialize``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .core.framework import Program
+
+__all__ = ["CompiledProgram", "ExecutionStrategy", "BuildStrategy"]
+
+
+class ExecutionStrategy:
+    """API parity with details/execution_strategy.h:22 — knobs that map to XLA
+    are honored; threading knobs are no-ops (XLA owns scheduling)."""
+
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 1
+        self.use_experimental_executor = False
+
+
+class BuildStrategy:
+    """API parity with details/build_strategy.h:35."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.memory_optimize = True
+        self.enable_inplace = True
+        self.fuse_all_reduce_ops = True
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class CompiledProgram:
+    """reference: compiler.py:62."""
+
+    def __init__(self, program_or_graph: Program):
+        self._program = program_or_graph
+        self._is_data_parallel = False
+        self._loss_name: Optional[str] = None
+        self._places: Optional[Sequence] = None
+        self._exec_strategy: Optional[ExecutionStrategy] = None
+        self._build_strategy: Optional[BuildStrategy] = None
+        self._share_vars_from: Optional["CompiledProgram"] = None
+        self._mesh_cache: Optional[Mesh] = None
+
+    def with_data_parallel(
+        self,
+        loss_name: Optional[str] = None,
+        build_strategy: Optional[BuildStrategy] = None,
+        exec_strategy: Optional[ExecutionStrategy] = None,
+        share_vars_from: Optional["CompiledProgram"] = None,
+        places: Optional[Sequence] = None,
+    ) -> "CompiledProgram":
+        """reference: compiler.py:116."""
+        self._is_data_parallel = True
+        self._loss_name = loss_name
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._exec_strategy = exec_strategy or ExecutionStrategy()
+        self._share_vars_from = share_vars_from
+        self._places = places
+        return self
+
+    # -- mesh construction ----------------------------------------------------
+    def _device_count(self) -> int:
+        if self._places is not None:
+            return len(self._places)
+        return len(jax.devices())
+
+    def _mesh(self) -> Optional[Mesh]:
+        if not self._is_data_parallel:
+            return None
+        if self._mesh_cache is None:
+            n = self._device_count()
+            devices = np.asarray(jax.devices()[:n])
+            self._mesh_cache = Mesh(devices, axis_names=("data",))
+        return self._mesh_cache
+
+    # -- execution (called from Executor.run) ---------------------------------
+    def _run(self, executor, feed, fetch_list, scope, return_numpy):
+        return executor._run_impl(
+            self._program,
+            feed=feed,
+            fetch_list=fetch_list,
+            scope=scope,
+            return_numpy=return_numpy,
+            mesh=self._mesh(),
+        )

@@ -1,0 +1,19 @@
+from . import unique_name  # noqa: F401
+from .dtypes import convert_dtype, is_float_dtype, to_jnp_dtype  # noqa: F401
+from .framework import (  # noqa: F401
+    Block,
+    Operator,
+    Parameter,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    grad_var_name,
+    name_scope,
+    program_guard,
+    switch_main_program,
+    switch_startup_program,
+)
+from .place import CPUPlace, CUDAPinnedPlace, Place, TPUPlace, is_compiled_with_tpu  # noqa: F401
+from .registry import OpContext, get_op_impl, has_op, register_op, registered_ops  # noqa: F401
+from .scope import Scope, global_scope, scope_guard  # noqa: F401

@@ -1,0 +1,54 @@
+"""Dtype canonicalization.
+
+Fluid uses a ``VarType`` proto enum (reference: framework.proto:105); here
+dtypes are canonical numpy/JAX dtype strings. bfloat16 is first-class — it is
+the TPU-native half precision (the reference's float16.h software-half role).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "bf16": "bfloat16",
+    "int": "int32",
+    "long": "int64",
+    "bool": "bool",
+    "uint8": "uint8",
+    "int8": "int8",
+    "int16": "int16",
+    "int32": "int32",
+    "int64": "int64",
+    "float16": "float16",
+    "bfloat16": "bfloat16",
+    "float32": "float32",
+    "float64": "float64",
+}
+
+
+def convert_dtype(dtype) -> str:
+    """Normalize any dtype spec to a canonical string."""
+    if dtype is None:
+        return "float32"
+    if isinstance(dtype, str):
+        if dtype in _ALIASES:
+            return _ALIASES[dtype]
+        return np.dtype(dtype).name
+    if dtype in (jnp.bfloat16,) or getattr(dtype, "name", None) == "bfloat16":
+        return "bfloat16"
+    return np.dtype(dtype).name
+
+
+def to_jnp_dtype(dtype):
+    name = convert_dtype(dtype)
+    if name == "bfloat16":
+        return jnp.bfloat16
+    return np.dtype(name)
+
+
+def is_float_dtype(dtype) -> bool:
+    return convert_dtype(dtype) in ("float16", "bfloat16", "float32", "float64")

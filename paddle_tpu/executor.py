@@ -1,0 +1,299 @@
+"""Executor: traces a Program into one jit-compiled XLA step.
+
+Fluid's ``Executor::Run`` (reference: ``framework/executor.cc:186,398``)
+interprets ops one by one against a Scope, paying per-op dispatch +
+InferShape + kernel-lookup every step. Here the op loop runs ONCE, at trace
+time, inside ``jax.jit``: every op impl is a pure JAX function over a
+name→array environment, so the whole step — forward, jax.grad backward,
+optimizer updates — compiles to a single fused XLA executable. State
+(persistable vars) is threaded functionally with buffer donation, giving
+in-place param updates in HBM.
+
+Feed/fetch semantics, the program cache (keyed like Fluid's
+``executor.py:224,310`` cache plus feed shapes for XLA's static-shape
+requirement), and scope handling mirror ``python/paddle/fluid/executor.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import ops as _ops  # noqa: F401 — registers all op impls
+from .core.dtypes import to_jnp_dtype
+from .core.framework import Program, Variable, default_main_program, grad_var_name
+from .core.place import Place, get_device
+from .core.registry import OpContext, get_op_impl
+from .core.scope import Scope, global_scope
+
+__all__ = ["Executor", "TraceContext"]
+
+# Ops that are markers/IO and never execute as kernels.
+_SKIP_OPS = frozenset({"backward_marker", "feed", "fetch"})
+
+
+class TraceContext:
+    """Per-trace state: RNG derivation, test mode, current op position."""
+
+    def __init__(self, program: Program, is_test: bool, base_rng):
+        self.program = program
+        self.is_test = is_test
+        self.base_rng = base_rng
+        self.current_op_idx = 0
+
+    def op_rng(self, ctx: OpContext):
+        seed = ctx.attr("seed", 0) or self.program.random_seed
+        if seed:
+            key = jax.random.PRNGKey(seed)
+        else:
+            key = self.base_rng
+        return jax.random.fold_in(key, self.current_op_idx)
+
+
+def run_block_ops(ops, env: Dict[str, Any], trace: TraceContext, offset: int = 0):
+    """The Fluid hot loop (executor.cc:433) — but executed once, under trace."""
+    for i, op in enumerate(ops):
+        if op.type in _SKIP_OPS:
+            continue
+        trace.current_op_idx = offset + i
+        impl = get_op_impl(op.type)
+        impl(OpContext(op, env, trace))
+
+
+def _canon(value, dtype_name: str):
+    arr = np.asarray(value)
+    target = to_jnp_dtype(dtype_name)
+    canonical = jax.dtypes.canonicalize_dtype(target)
+    if arr.dtype != canonical:
+        arr = arr.astype(canonical)
+    return arr
+
+
+class _CompiledStep:
+    """A specialization of (program, feed sig, fetch list, state names).
+
+    With a mesh: state replicated, feeds sharded on the ``data`` axis —
+    XLA/GSPMD inserts the gradient psum over ICI (the TPU-native
+    ParallelExecutor+NCCL path, SURVEY.md §7).
+    """
+
+    def __init__(self, program: Program, feed_names: Tuple[str, ...],
+                 fetch_names: Tuple[str, ...], state_names: Tuple[str, ...],
+                 is_test: bool, jit: bool = True, mesh=None):
+        self.program = program
+        self.feed_names = feed_names
+        self.fetch_names = fetch_names
+        self.state_names = state_names
+        self.is_test = is_test
+
+        bw = program._backward_info
+        block = program.global_block
+        ops = block.ops
+        marker_idx = None
+        if bw is not None:
+            for i, op in enumerate(ops):
+                if op.type == "backward_marker":
+                    marker_idx = i
+                    break
+
+        def step(state, feeds, rng_key):
+            trace = TraceContext(program, is_test, rng_key)
+            if bw is None or marker_idx is None:
+                env = dict(state)
+                env.update(feeds)
+                run_block_ops(ops, env, trace)
+            else:
+                loss_name = bw["loss"]
+                param_to_grad = bw["param_to_grad"]
+                param_names = [p for p in param_to_grad if p in state]
+                params = {n: state[n] for n in param_names}
+                rest = {n: v for n, v in state.items() if n not in params}
+                fwd_ops = ops[:marker_idx]
+                post_ops = ops[marker_idx + 1 :]
+
+                def fwd(params_in):
+                    env = dict(rest)
+                    env.update(params_in)
+                    env.update(feeds)
+                    run_block_ops(fwd_ops, env, trace)
+                    loss = jnp.sum(env[loss_name])
+                    return loss, env
+
+                (loss_val, env), grads = jax.value_and_grad(fwd, has_aux=True)(params)
+                for p in param_names:
+                    env[param_to_grad[p]] = grads[p]
+                env[grad_var_name(loss_name)] = jnp.ones_like(loss_val)
+                run_block_ops(post_ops, env, trace, offset=marker_idx + 1)
+
+            new_state = {}
+            for n in self.state_names:
+                new_state[n] = env.get(n, state.get(n))
+            fetches = [env[f] for f in self.fetch_names]
+            return new_state, fetches
+
+        if jit and mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            repl = NamedSharding(mesh, P())
+            feed_sh = {n: NamedSharding(mesh, P("data")) for n in feed_names}
+            self.fn = jax.jit(
+                step,
+                in_shardings=(repl, feed_sh, repl),
+                donate_argnums=(0,),
+            )
+        elif jit:
+            self.fn = jax.jit(step, donate_argnums=(0,))
+        else:
+            self.fn = step
+
+    def __call__(self, state, feeds, rng_key):
+        return self.fn(state, feeds, rng_key)
+
+
+class Executor:
+    """reference: python/paddle/fluid/executor.py:262."""
+
+    def __init__(self, place: Optional[Place] = None):
+        self.place = place
+        self._cache: Dict[tuple, _CompiledStep] = {}
+        self._step_counters: Dict[int, int] = {}
+
+    def close(self):
+        """Parity with executor.py:388 (pserver notify) — nothing to release."""
+        self._cache.clear()
+
+    # -- helpers --------------------------------------------------------------
+    @staticmethod
+    def _fetch_names(fetch_list) -> Tuple[str, ...]:
+        names = []
+        for f in fetch_list or []:
+            names.append(f.name if isinstance(f, Variable) else str(f))
+        return tuple(names)
+
+    @staticmethod
+    def _persistable_names(program: Program, scope: Scope) -> Tuple[str, ...]:
+        names = set()
+        for v in program.list_vars():
+            if v.persistable:
+                names.add(v.name)
+        # vars already in scope that program ops read (e.g. created by startup)
+        return tuple(sorted(names))
+
+    def _gather_state(self, program: Program, scope: Scope, names) -> Dict[str, Any]:
+        state = {}
+        for n in names:
+            val = scope.find_var(n)
+            if val is not None:
+                state[n] = val
+        return state
+
+    def _rng_key(self, program: Program):
+        pid = id(program)
+        step = self._step_counters.get(pid, 0)
+        self._step_counters[pid] = step + 1
+        seed = program.random_seed or 0
+        return jax.random.fold_in(jax.random.PRNGKey(seed), step)
+
+    # -- the public API -------------------------------------------------------
+    def run(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[Sequence] = None,
+        feed_var_name: str = "feed",
+        fetch_var_name: str = "fetch",
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+        use_program_cache: bool = True,
+    ):
+        from .compiler import CompiledProgram as _UserCompiledProgram
+
+        if isinstance(program, _UserCompiledProgram):
+            return program._run(self, feed, fetch_list, scope, return_numpy)
+
+        return self._run_impl(
+            program, feed, fetch_list, scope, return_numpy, use_program_cache
+        )
+
+    def _run_impl(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[Sequence] = None,
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+        use_program_cache: bool = True,
+        mesh=None,
+    ):
+        if program is None:
+            program = default_main_program()
+        if scope is None:
+            scope = global_scope()
+        feed = feed or {}
+        fetch_names = self._fetch_names(fetch_list)
+
+        block = program.global_block
+        feeds = {}
+        feed_sig = []
+        for name in sorted(feed):
+            var = block.var(name) if block.has_var(name) else None
+            dtype = var.dtype if var is not None else np.asarray(feed[name]).dtype.name
+            arr = _canon(feed[name], dtype)
+            feeds[name] = arr
+            feed_sig.append((name, arr.shape, str(arr.dtype)))
+
+        state_names = self._persistable_names(program, scope)
+        # state vars that actually exist (startup creates them on first run)
+        state = self._gather_state(program, scope, state_names)
+        avail_state_names = tuple(sorted(state))
+
+        from .core.framework import in_test_mode
+
+        is_test = in_test_mode()
+        is_training_or_has_feed = bool(feeds) or bool(fetch_names)
+        key = (
+            id(program),
+            program._version,
+            tuple(feed_sig),
+            fetch_names,
+            avail_state_names,
+            is_test,
+            id(mesh) if mesh is not None else None,
+        )
+        compiled = self._cache.get(key) if use_program_cache else None
+        if compiled is None:
+            compiled = _CompiledStep(
+                program,
+                tuple(sorted(feeds)),
+                fetch_names,
+                state_names,
+                is_test=is_test,
+                jit=is_training_or_has_feed,
+                mesh=mesh,
+            )
+            if use_program_cache:
+                self._cache[key] = compiled
+
+        rng_key = self._rng_key(program)
+        dev = get_device(self.place)
+        if dev is not None and feeds:
+            feeds = {k: jax.device_put(v, dev) for k, v in feeds.items()}
+        new_state, fetches = compiled(state, feeds, rng_key)
+
+        for n, v in new_state.items():
+            if v is not None:
+                scope.set_var(n, v)
+
+        if not fetch_names:
+            return []
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    # Fluid parity alias
+    def infer_from_program(self, *a, **kw):
+        return self.run(*a, **kw)

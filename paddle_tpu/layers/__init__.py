@@ -1,0 +1,17 @@
+from . import io, nn, tensor  # noqa: F401
+from .io import data  # noqa: F401
+from .layer_helper import LayerHelper, ParamAttr  # noqa: F401
+from .nn import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+from .tensor import (  # noqa: F401
+    assign,
+    cast,
+    concat,
+    create_global_var,
+    fill_constant,
+    ones,
+    reshape,
+    scale,
+    transpose,
+    zeros,
+)

@@ -1,0 +1,32 @@
+"""IO layers: data declaration (reference: python/paddle/fluid/layers/io.py).
+
+``data`` declares a feed variable with a leading batch dim of -1 (dynamic),
+matching Fluid (``io.py data, append_batch_size=True``). py_reader /
+double_buffer prefetching lives in paddle_tpu/reader.py (host pipeline +
+jax.device_put prefetch), since under XLA the graph itself doesn't own IO.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..core.framework import default_main_program, default_startup_program
+
+__all__ = ["data"]
+
+
+def data(name: str, shape: Sequence[int], dtype="float32", append_batch_size: bool = True,
+         lod_level: int = 0, stop_gradient: bool = True):
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    block = default_main_program().global_block
+    var = block.create_var(
+        name=name,
+        shape=shape,
+        dtype=dtype,
+        is_data=True,
+        stop_gradient=stop_gradient,
+    )
+    var.lod_level = lod_level
+    return var

@@ -1,0 +1,1140 @@
+"""Neural-net layers (reference: python/paddle/fluid/layers/nn.py — 155 defs).
+
+Each layer appends symbolic ops to the default main program via LayerHelper,
+exactly Fluid's construction model (``nn.py:195`` fc et al.). The op impls are
+pure JAX and the whole program compiles to one XLA computation.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+from ..core.framework import Variable
+from .layer_helper import LayerHelper, ParamAttr
+
+__all__ = [
+    "fc",
+    "embedding",
+    "conv2d",
+    "conv3d",
+    "conv2d_transpose",
+    "pool2d",
+    "pool3d",
+    "batch_norm",
+    "layer_norm",
+    "group_norm",
+    "instance_norm",
+    "data_norm",
+    "lrn",
+    "dropout",
+    "softmax",
+    "log_softmax",
+    "cross_entropy",
+    "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits",
+    "square_error_cost",
+    "smooth_l1",
+    "huber_loss",
+    "log_loss",
+    "matmul",
+    "mul",
+    "reduce_sum",
+    "reduce_mean",
+    "reduce_max",
+    "reduce_min",
+    "reduce_prod",
+    "mean",
+    "relu",
+    "gelu",
+    "tanh",
+    "sigmoid",
+    "leaky_relu",
+    "prelu",
+    "elu",
+    "relu6",
+    "swish",
+    "maxout",
+    "hard_sigmoid",
+    "soft_relu",
+    "brelu",
+    "pow",
+    "stanh",
+    "l2_normalize",
+    "clip",
+    "clip_by_norm",
+    "one_hot",
+    "topk",
+    "argsort",
+    "argmax",
+    "argmin",
+    "accuracy",
+    "auc",
+    "pad",
+    "pad2d",
+    "image_resize",
+    "resize_bilinear",
+    "resize_nearest",
+    "pixel_shuffle",
+    "flatten",
+    "unsqueeze",
+    "squeeze",
+    "stack",
+    "unstack",
+    "expand",
+    "gather",
+    "gather_nd",
+    "scatter",
+    "slice",
+    "strided_slice",
+    "shape",
+    "where",
+    "cos_sim",
+    "dot",
+    "elementwise_add",
+    "elementwise_sub",
+    "elementwise_mul",
+    "elementwise_div",
+    "elementwise_max",
+    "elementwise_min",
+    "elementwise_pow",
+    "elementwise_mod",
+    "uniform_random",
+    "gaussian_random",
+    "uniform_random_batch_size_like",
+    "gaussian_random_batch_size_like",
+    "bilinear_tensor_product",
+    "split",
+    "multiplex",
+    "label_smooth",
+    "mean_iou",
+    "space_to_depth",
+    "shuffle_channel",
+    "autoincreased_step_counter",
+]
+
+
+def _single_op_layer(helper_name, op_type, x, attrs=None, x_slot="X", out_slot="Out", name=None, dtype=None):
+    helper = LayerHelper(helper_name, name=name)
+    out = helper.create_variable_for_type_inference(dtype or x.dtype)
+    helper.append_op(op_type, inputs={x_slot: x}, outputs={out_slot: out}, attrs=attrs or {})
+    return out
+
+
+def fc(
+    input,
+    size: int,
+    num_flatten_dims: int = 1,
+    param_attr=None,
+    bias_attr=None,
+    act: Optional[str] = None,
+    is_test: bool = False,
+    name: Optional[str] = None,
+):
+    """Fully-connected layer (reference: layers/nn.py:195).
+
+    Multiple inputs each get their own weight; results are summed (mul ops +
+    sum op, like Fluid), then bias + activation.
+    """
+    helper = LayerHelper("fc", input=input, param_attr=param_attr, bias_attr=bias_attr, act=act, name=name)
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    param_attrs = param_attr if isinstance(param_attr, (list, tuple)) else [param_attr] * len(inputs)
+    mul_results = []
+    for inp, pattr in zip(inputs, param_attrs):
+        input_shape = inp.shape
+        import numpy as _np
+
+        in_features = int(_np.prod([d for d in input_shape[num_flatten_dims:]]))
+        w = helper.create_parameter(pattr, shape=[in_features, size], dtype=inp.dtype)
+        tmp = helper.create_variable_for_type_inference(inp.dtype)
+        helper.append_op(
+            "mul",
+            inputs={"X": inp, "Y": w},
+            outputs={"Out": tmp},
+            attrs={"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1},
+        )
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_variable_for_type_inference(inputs[0].dtype)
+        helper.append_op("sum", inputs={"X": mul_results}, outputs={"Out": pre_bias})
+    if bias_attr is False:
+        pre_act = pre_bias
+    else:
+        pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
+    return helper.append_activation(pre_act)
+
+
+def embedding(
+    input,
+    size: Sequence[int],
+    is_sparse: bool = False,
+    is_distributed: bool = False,
+    padding_idx: Optional[int] = None,
+    param_attr=None,
+    dtype="float32",
+    name=None,
+):
+    """Embedding lookup (reference: layers/nn.py embedding). ``is_sparse`` is
+    accepted for API parity; grads are dense XLA scatter-adds either way."""
+    helper = LayerHelper("embedding", name=name)
+    w = helper.create_parameter(param_attr, shape=list(size), dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    padding_idx = -1 if padding_idx is None else (padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+    helper.append_op(
+        "lookup_table",
+        inputs={"W": w, "Ids": input},
+        outputs={"Out": out},
+        attrs={"padding_idx": padding_idx, "is_sparse": is_sparse, "is_distributed": is_distributed},
+    )
+    return out
+
+
+def conv2d(
+    input,
+    num_filters: int,
+    filter_size,
+    stride=1,
+    padding=0,
+    dilation=1,
+    groups: int = 1,
+    param_attr=None,
+    bias_attr=None,
+    use_cudnn: bool = True,
+    act: Optional[str] = None,
+    name: Optional[str] = None,
+):
+    """2-D convolution, NCHW, OIHW weights (reference: layers/nn.py conv2d)."""
+    helper = LayerHelper("conv2d", bias_attr=bias_attr, act=act, name=name)
+    num_channels = input.shape[1]
+    filter_size = _pair(filter_size)
+    stride = _pair(stride)
+    padding = _pair(padding)
+    dilation = _pair(dilation)
+    groups = groups or 1
+    filter_shape = [num_filters, num_channels // groups] + list(filter_size)
+
+    import math as _math
+
+    std = (2.0 / (filter_size[0] * filter_size[1] * num_channels)) ** 0.5
+    from .. import initializer as init_mod
+
+    w = helper.create_parameter(
+        param_attr,
+        shape=filter_shape,
+        dtype=input.dtype,
+        default_initializer=init_mod.Normal(0.0, std),
+    )
+    pre_bias = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "conv2d",
+        inputs={"Input": input, "Filter": w},
+        outputs={"Output": pre_bias},
+        attrs={
+            "strides": list(stride),
+            "paddings": list(padding),
+            "dilations": list(dilation),
+            "groups": groups,
+            "use_cudnn": use_cudnn,
+        },
+    )
+    if bias_attr is False:
+        pre_act = pre_bias
+    else:
+        bias = helper.create_parameter(
+            ParamAttr.to_attr(bias_attr), shape=[num_filters], dtype=input.dtype, is_bias=True
+        )
+        pre_act = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op(
+            "elementwise_add",
+            inputs={"X": pre_bias, "Y": bias},
+            outputs={"Out": pre_act},
+            attrs={"axis": 1},
+        )
+    return helper.append_activation(pre_act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1, groups=1,
+           param_attr=None, bias_attr=None, use_cudnn=True, act=None, name=None):
+    helper = LayerHelper("conv3d", bias_attr=bias_attr, act=act, name=name)
+    num_channels = input.shape[1]
+    fs = _triple(filter_size)
+    filter_shape = [num_filters, num_channels // (groups or 1)] + list(fs)
+    w = helper.create_parameter(param_attr, shape=filter_shape, dtype=input.dtype)
+    pre_bias = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "conv3d",
+        inputs={"Input": input, "Filter": w},
+        outputs={"Output": pre_bias},
+        attrs={
+            "strides": list(_triple(stride)),
+            "paddings": list(_triple(padding)),
+            "dilations": list(_triple(dilation)),
+            "groups": groups or 1,
+        },
+    )
+    if bias_attr is False:
+        pre_act = pre_bias
+    else:
+        bias = helper.create_parameter(ParamAttr.to_attr(bias_attr), shape=[num_filters], dtype=input.dtype, is_bias=True)
+        pre_act = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("elementwise_add", inputs={"X": pre_bias, "Y": bias}, outputs={"Out": pre_act}, attrs={"axis": 1})
+    return helper.append_activation(pre_act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None, padding=0,
+                     stride=1, dilation=1, groups=1, param_attr=None, bias_attr=None,
+                     use_cudnn=True, act=None, name=None):
+    helper = LayerHelper("conv2d_transpose", bias_attr=bias_attr, act=act, name=name)
+    num_channels = input.shape[1]
+    stride = _pair(stride)
+    padding = _pair(padding)
+    dilation = _pair(dilation)
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("filter_size or output_size required")
+        output_size = _pair(output_size)
+        h_in, w_in = input.shape[2], input.shape[3]
+        filter_size = [
+            (output_size[0] - (h_in - 1) * stride[0] + 2 * padding[0] - 1) // dilation[0] + 1,
+            (output_size[1] - (w_in - 1) * stride[1] + 2 * padding[1] - 1) // dilation[1] + 1,
+        ]
+    else:
+        filter_size = list(_pair(filter_size))
+    filter_shape = [num_channels, num_filters // (groups or 1)] + filter_size
+    w = helper.create_parameter(param_attr, shape=filter_shape, dtype=input.dtype)
+    pre_bias = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "conv2d_transpose",
+        inputs={"Input": input, "Filter": w},
+        outputs={"Output": pre_bias},
+        attrs={
+            "strides": list(stride),
+            "paddings": list(padding),
+            "dilations": list(dilation),
+            "groups": groups or 1,
+        },
+    )
+    if bias_attr is False:
+        pre_act = pre_bias
+    else:
+        bias = helper.create_parameter(ParamAttr.to_attr(bias_attr), shape=[num_filters], dtype=input.dtype, is_bias=True)
+        pre_act = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("elementwise_add", inputs={"X": pre_bias, "Y": bias}, outputs={"Out": pre_act}, attrs={"axis": 1})
+    return helper.append_activation(pre_act)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
+           global_pooling=False, use_cudnn=True, ceil_mode=False, exclusive=True, name=None):
+    helper = LayerHelper("pool2d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "pool2d",
+        inputs={"X": input},
+        outputs={"Out": out},
+        attrs={
+            "pooling_type": pool_type,
+            "ksize": list(_pair(pool_size)),
+            "strides": list(_pair(pool_stride)),
+            "paddings": list(_pair(pool_padding)),
+            "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode,
+            "exclusive": exclusive,
+        },
+    )
+    return out
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
+           global_pooling=False, use_cudnn=True, ceil_mode=False, exclusive=True, name=None):
+    helper = LayerHelper("pool3d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "pool3d",
+        inputs={"X": input},
+        outputs={"Out": out},
+        attrs={
+            "pooling_type": pool_type,
+            "ksize": list(_triple(pool_size)),
+            "strides": list(_triple(pool_stride)),
+            "paddings": list(_triple(pool_padding)),
+            "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode,
+            "exclusive": exclusive,
+        },
+    )
+    return out
+
+
+def batch_norm(
+    input,
+    act: Optional[str] = None,
+    is_test: bool = False,
+    momentum: float = 0.9,
+    epsilon: float = 1e-5,
+    param_attr=None,
+    bias_attr=None,
+    data_layout: str = "NCHW",
+    name: Optional[str] = None,
+    moving_mean_name: Optional[str] = None,
+    moving_variance_name: Optional[str] = None,
+    use_global_stats: bool = False,
+):
+    """Batch normalization (reference: layers/nn.py batch_norm)."""
+    from ..core import unique_name
+    from .. import initializer as init_mod
+
+    helper = LayerHelper("batch_norm", act=act, name=name)
+    dtype = input.dtype
+    channels = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = helper.create_parameter(
+        param_attr, shape=[channels], dtype=dtype, default_initializer=init_mod.Constant(1.0)
+    )
+    bias = helper.create_parameter(ParamAttr.to_attr(bias_attr), shape=[channels], dtype=dtype, is_bias=True)
+    mean_name = moving_mean_name or unique_name.generate(helper.name + ".mean")
+    var_name = moving_variance_name or unique_name.generate(helper.name + ".var")
+    mean = helper.create_or_get_global_variable([channels], dtype, mean_name, initializer=init_mod.Constant(0.0))
+    variance = helper.create_or_get_global_variable([channels], dtype, var_name, initializer=init_mod.Constant(1.0))
+
+    out = helper.create_variable_for_type_inference(dtype)
+    saved_mean = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    saved_var = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op(
+        "batch_norm",
+        inputs={"X": input, "Scale": scale, "Bias": bias, "Mean": mean, "Variance": variance},
+        outputs={
+            "Y": out,
+            "MeanOut": mean,
+            "VarianceOut": variance,
+            "SavedMean": saved_mean,
+            "SavedVariance": saved_var,
+        },
+        attrs={
+            "momentum": momentum,
+            "epsilon": epsilon,
+            "is_test": is_test,
+            "data_layout": data_layout,
+            "use_global_stats": use_global_stats,
+        },
+    )
+    return helper.append_activation(out)
+
+
+def layer_norm(
+    input,
+    scale: bool = True,
+    shift: bool = True,
+    begin_norm_axis: int = 1,
+    epsilon: float = 1e-5,
+    param_attr=None,
+    bias_attr=None,
+    act: Optional[str] = None,
+    name: Optional[str] = None,
+):
+    """Layer normalization (reference: layers/nn.py layer_norm)."""
+    from .. import initializer as init_mod
+    import numpy as _np
+
+    helper = LayerHelper("layer_norm", act=act, name=name)
+    dtype = input.dtype
+    norm_shape = [int(_np.prod(input.shape[begin_norm_axis:]))]
+    inputs = {"X": input}
+    if scale:
+        s = helper.create_parameter(param_attr, shape=norm_shape, dtype=dtype, default_initializer=init_mod.Constant(1.0))
+        inputs["Scale"] = s
+    if shift:
+        b = helper.create_parameter(ParamAttr.to_attr(bias_attr), shape=norm_shape, dtype=dtype, is_bias=True)
+        inputs["Bias"] = b
+    out = helper.create_variable_for_type_inference(dtype)
+    mean_out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    var_out = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op(
+        "layer_norm",
+        inputs=inputs,
+        outputs={"Y": out, "Mean": mean_out, "Variance": var_out},
+        attrs={"begin_norm_axis": begin_norm_axis, "epsilon": epsilon},
+    )
+    return helper.append_activation(out)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    from .. import initializer as init_mod
+
+    helper = LayerHelper("group_norm", act=act, name=name)
+    channels = input.shape[1]
+    inputs = {"X": input}
+    if param_attr is not False:
+        inputs["Scale"] = helper.create_parameter(param_attr, shape=[channels], dtype=input.dtype,
+                                                  default_initializer=init_mod.Constant(1.0))
+    if bias_attr is not False:
+        inputs["Bias"] = helper.create_parameter(ParamAttr.to_attr(bias_attr), shape=[channels],
+                                                 dtype=input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mean_out = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    var_out = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op("group_norm", inputs=inputs,
+                     outputs={"Y": out, "Mean": mean_out, "Variance": var_out},
+                     attrs={"groups": groups, "epsilon": epsilon})
+    return helper.append_activation(out)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None, name=None):
+    from .. import initializer as init_mod
+
+    helper = LayerHelper("instance_norm", name=name)
+    channels = input.shape[1]
+    scale = helper.create_parameter(param_attr, shape=[channels], dtype=input.dtype,
+                                    default_initializer=init_mod.Constant(1.0))
+    bias = helper.create_parameter(ParamAttr.to_attr(bias_attr), shape=[channels], dtype=input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("instance_norm", inputs={"X": input, "Scale": scale, "Bias": bias},
+                     outputs={"Y": out}, attrs={"epsilon": epsilon})
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None, name=None):
+    raise NotImplementedError("data_norm layer: use batch_norm; op exists for parity")
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mid = helper.create_variable_for_type_inference(input.dtype, stop_gradient=True)
+    helper.append_op("lrn", inputs={"X": input}, outputs={"Out": out, "MidOut": mid},
+                     attrs={"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    mask = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op(
+        "dropout",
+        inputs={"X": x},
+        outputs={"Out": out, "Mask": mask},
+        attrs={
+            "dropout_prob": dropout_prob,
+            "is_test": is_test,
+            "seed": seed or 0,
+            "dropout_implementation": dropout_implementation,
+        },
+    )
+    return out
+
+
+def softmax(input, use_cudnn=False, name=None, axis=-1):
+    return _single_op_layer("softmax", "softmax", input, {"axis": axis}, name=name)
+
+
+def log_softmax(input, axis=-1, name=None):
+    return _single_op_layer("log_softmax", "log_softmax", input, {"axis": axis}, name=name)
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    helper = LayerHelper("cross_entropy")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "cross_entropy",
+        inputs={"X": input, "Label": label},
+        outputs={"Y": out},
+        attrs={"soft_label": soft_label, "ignore_index": ignore_index},
+    )
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    softmax_out = helper.create_variable_for_type_inference(logits.dtype)
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op(
+        "softmax_with_cross_entropy",
+        inputs={"Logits": logits, "Label": label},
+        outputs={"Softmax": softmax_out, "Loss": loss},
+        attrs={"soft_label": soft_label, "ignore_index": ignore_index},
+    )
+    if return_softmax:
+        return loss, softmax_out
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100, normalize=False, name=None):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "sigmoid_cross_entropy_with_logits",
+        inputs={"X": x, "Label": label},
+        outputs={"Out": out},
+        attrs={"ignore_index": ignore_index, "normalize": normalize},
+    )
+    return out
+
+
+def square_error_cost(input, label):
+    """(input-label)^2 (reference: layers/nn.py square_error_cost)."""
+    helper = LayerHelper("square_error_cost")
+    diff = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("elementwise_sub", inputs={"X": input, "Y": label}, outputs={"Out": diff})
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("square", inputs={"X": diff}, outputs={"Out": out})
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    helper = LayerHelper("smooth_l1")
+    diff = helper.create_variable_for_type_inference(x.dtype)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": x, "Y": y}
+    if inside_weight is not None:
+        inputs["InsideWeight"] = inside_weight
+    if outside_weight is not None:
+        inputs["OutsideWeight"] = outside_weight
+    helper.append_op("smooth_l1_loss", inputs=inputs, outputs={"Diff": diff, "Out": out},
+                     attrs={"sigma": sigma or 1.0})
+    return out
+
+
+def huber_loss(input, label, delta):
+    helper = LayerHelper("huber_loss")
+    residual = helper.create_variable_for_type_inference(input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("huber_loss", inputs={"X": input, "Y": label},
+                     outputs={"Residual": residual, "Out": out}, attrs={"delta": delta})
+    return out
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    helper = LayerHelper("log_loss", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("log_loss", inputs={"Predicted": input, "Labels": label},
+                     outputs={"Loss": out}, attrs={"epsilon": epsilon})
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "matmul",
+        inputs={"X": x, "Y": y},
+        outputs={"Out": out},
+        attrs={"transpose_X": transpose_x, "transpose_Y": transpose_y, "alpha": float(alpha)},
+    )
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "mul",
+        inputs={"X": x, "Y": y},
+        outputs={"Out": out},
+        attrs={"x_num_col_dims": x_num_col_dims, "y_num_col_dims": y_num_col_dims},
+    )
+    return out
+
+
+def _reduce_layer(op_type, input, dim, keep_dim, name):
+    if dim is None:
+        attrs = {"dim": [0], "keep_dim": keep_dim, "reduce_all": True}
+    else:
+        dim = dim if isinstance(dim, (list, tuple)) else [dim]
+        attrs = {"dim": list(dim), "keep_dim": keep_dim, "reduce_all": False}
+    return _single_op_layer(op_type, op_type, input, attrs, name=name)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_sum", input, dim, keep_dim, name)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_mean", input, dim, keep_dim, name)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_max", input, dim, keep_dim, name)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_min", input, dim, keep_dim, name)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _reduce_layer("reduce_prod", input, dim, keep_dim, name)
+
+
+def mean(x, name=None):
+    return _single_op_layer("mean", "mean", x, name=name)
+
+
+# -- activations as layers ----------------------------------------------------
+
+
+def _act(op_type, x, attrs=None, name=None):
+    return _single_op_layer(op_type, op_type, x, attrs, name=name)
+
+
+def relu(x, name=None):
+    return _act("relu", x, name=name)
+
+
+def gelu(x, approximate=False, name=None):
+    return _act("gelu", x, {"approximate": approximate}, name=name)
+
+
+def tanh(x, name=None):
+    return _act("tanh", x, name=name)
+
+
+def sigmoid(x, name=None):
+    return _act("sigmoid", x, name=name)
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    return _act("leaky_relu", x, {"alpha": alpha}, name=name)
+
+
+def elu(x, alpha=1.0, name=None):
+    return _act("elu", x, {"alpha": alpha}, name=name)
+
+
+def relu6(x, threshold=6.0, name=None):
+    return _act("relu6", x, {"threshold": threshold}, name=name)
+
+
+def swish(x, beta=1.0, name=None):
+    return _act("swish", x, {"beta": beta}, name=name)
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    return _act("hard_sigmoid", x, {"slope": slope, "offset": offset}, name=name)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    return _act("soft_relu", x, {"threshold": threshold}, name=name)
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return _act("brelu", x, {"t_min": t_min, "t_max": t_max}, name=name)
+
+
+def pow(x, factor=1.0, name=None):
+    return _act("pow", x, {"factor": factor}, name=name)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _act("stanh", x, {"scale_a": scale_a, "scale_b": scale_b}, name=name)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    from .. import initializer as init_mod
+
+    helper = LayerHelper("prelu", name=name)
+    alpha_shape = [1] if mode == "all" else ([x.shape[1]] if mode == "channel" else list(x.shape[1:]))
+    alpha = helper.create_parameter(param_attr, shape=alpha_shape, dtype=x.dtype,
+                                    default_initializer=init_mod.Constant(0.25))
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("prelu", inputs={"X": x, "Alpha": alpha}, outputs={"Out": out}, attrs={"mode": mode})
+    return out
+
+
+def maxout(x, groups, name=None):
+    return _act("maxout", x, {"groups": groups}, name=name)
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    helper = LayerHelper("l2_normalize", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    norm = helper.create_variable_for_type_inference(x.dtype, stop_gradient=True)
+    helper.append_op("norm", inputs={"X": x}, outputs={"Out": out, "Norm": norm},
+                     attrs={"axis": axis, "epsilon": epsilon})
+    return out
+
+
+def clip(x, min, max, name=None):
+    return _single_op_layer("clip", "clip", x, {"min": min, "max": max}, name=name)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    return _single_op_layer("clip_by_norm", "clip_by_norm", x, {"max_norm": max_norm}, name=name)
+
+
+def one_hot(input, depth):
+    helper = LayerHelper("one_hot")
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op("one_hot", inputs={"X": input}, outputs={"Out": out}, attrs={"depth": depth})
+    return out
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", name=name)
+    values = helper.create_variable_for_type_inference(input.dtype)
+    indices = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    helper.append_op("top_k", inputs={"X": input}, outputs={"Out": values, "Indices": indices}, attrs={"k": k})
+    return values, indices
+
+
+def argsort(input, axis=-1, name=None):
+    helper = LayerHelper("argsort", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ids = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    helper.append_op("argsort", inputs={"X": input}, outputs={"Out": out, "Indices": ids}, attrs={"axis": axis})
+    return out, ids
+
+
+def argmax(x, axis=0, name=None):
+    return _single_op_layer("arg_max", "arg_max", x, {"axis": axis}, dtype="int64", name=name)
+
+
+def argmin(x, axis=0, name=None):
+    return _single_op_layer("arg_min", "arg_min", x, {"axis": axis}, dtype="int64", name=name)
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Reference: layers/metric_op.py accuracy — top-k then accuracy op."""
+    helper = LayerHelper("accuracy")
+    values, indices = topk(input, k)
+    acc_out = helper.create_variable_for_type_inference("float32")
+    correct = correct or helper.create_variable_for_type_inference("int32", stop_gradient=True)
+    total = total or helper.create_variable_for_type_inference("int32", stop_gradient=True)
+    helper.append_op(
+        "accuracy",
+        inputs={"Out": values, "Indices": indices, "Label": label},
+        outputs={"Accuracy": acc_out, "Correct": correct, "Total": total},
+    )
+    return acc_out
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1, slide_steps=1):
+    """Streaming AUC (reference: layers/metric_op.py auc)."""
+    from .. import initializer as init_mod
+    from ..core import unique_name
+
+    helper = LayerHelper("auc")
+    stat_pos = helper.create_or_get_global_variable(
+        [1, num_thresholds + 1], "float32", unique_name.generate("auc_stat_pos"),
+        initializer=init_mod.Constant(0.0))
+    stat_neg = helper.create_or_get_global_variable(
+        [1, num_thresholds + 1], "float32", unique_name.generate("auc_stat_neg"),
+        initializer=init_mod.Constant(0.0))
+    auc_out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "auc",
+        inputs={"Predict": input, "Label": label, "StatPos": stat_pos, "StatNeg": stat_neg},
+        outputs={"AUC": auc_out, "StatPosOut": stat_pos, "StatNegOut": stat_neg},
+        attrs={"curve": curve, "num_thresholds": num_thresholds},
+    )
+    return auc_out, [stat_pos, stat_neg]
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    return _single_op_layer("pad", "pad", x, {"paddings": paddings, "pad_value": pad_value}, name=name)
+
+
+def pad2d(input, paddings=[0, 0, 0, 0], mode="constant", pad_value=0.0, data_format="NCHW", name=None):
+    return _single_op_layer("pad2d", "pad2d", input,
+                            {"paddings": list(paddings), "mode": mode, "pad_value": pad_value}, name=name)
+
+
+def image_resize(input, out_shape=None, scale=None, name=None, resample="BILINEAR",
+                 actual_shape=None, align_corners=True, align_mode=1):
+    op = "bilinear_interp" if resample.upper() == "BILINEAR" else "nearest_interp"
+    attrs = {"scale": scale or 0.0}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), int(out_shape[1])
+    helper = LayerHelper(op, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(op, inputs={"X": input}, outputs={"Out": out}, attrs=attrs)
+    return out
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None, **kw):
+    return image_resize(input, out_shape, scale, name, "BILINEAR")
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None, **kw):
+    return image_resize(input, out_shape, scale, name, "NEAREST")
+
+
+def pixel_shuffle(x, upscale_factor):
+    helper = LayerHelper("pixel_shuffle")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("pixel_shuffle", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"upscale_factor": upscale_factor})
+    return out
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper("flatten", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("flatten", inputs={"X": x}, outputs={"Out": out}, attrs={"axis": axis})
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    return _single_op_layer("unsqueeze", "unsqueeze", input, {"axes": list(axes)}, name=name)
+
+
+def squeeze(input, axes, name=None):
+    return _single_op_layer("squeeze", "squeeze", input, {"axes": list(axes)}, name=name)
+
+
+def stack(x, axis=0):
+    helper = LayerHelper("stack")
+    x = x if isinstance(x, (list, tuple)) else [x]
+    out = helper.create_variable_for_type_inference(x[0].dtype)
+    helper.append_op("stack", inputs={"X": list(x)}, outputs={"Y": out}, attrs={"axis": axis})
+    return out
+
+
+def unstack(x, axis=0, num=None):
+    helper = LayerHelper("unstack")
+    num = num or x.shape[axis]
+    outs = [helper.create_variable_for_type_inference(x.dtype) for _ in range(num)]
+    helper.append_op("unstack", inputs={"X": x}, outputs={"Y": outs}, attrs={"axis": axis, "num": num})
+    return outs
+
+
+def expand(x, expand_times, name=None):
+    return _single_op_layer("expand", "expand", x, {"expand_times": list(expand_times)}, name=name)
+
+
+def gather(input, index):
+    helper = LayerHelper("gather")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("gather", inputs={"X": input, "Index": index}, outputs={"Out": out})
+    return out
+
+
+def gather_nd(input, index, name=None):
+    helper = LayerHelper("gather_nd", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("gather_nd", inputs={"X": input, "Index": index}, outputs={"Out": out})
+    return out
+
+
+def scatter(input, index, updates, overwrite=True, name=None):
+    helper = LayerHelper("scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("scatter", inputs={"X": input, "Ids": index, "Updates": updates},
+                     outputs={"Out": out}, attrs={"overwrite": overwrite})
+    return out
+
+
+def slice(input, axes, starts, ends):
+    helper = LayerHelper("slice")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("slice", inputs={"Input": input}, outputs={"Out": out},
+                     attrs={"axes": list(axes), "starts": list(starts), "ends": list(ends)})
+    return out
+
+
+def strided_slice(input, axes, starts, ends, strides):
+    helper = LayerHelper("strided_slice")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("strided_slice", inputs={"Input": input}, outputs={"Out": out},
+                     attrs={"axes": list(axes), "starts": list(starts), "ends": list(ends),
+                            "strides": list(strides)})
+    return out
+
+
+def shape(input):
+    helper = LayerHelper("shape")
+    out = helper.create_variable_for_type_inference("int32", stop_gradient=True)
+    helper.append_op("shape", inputs={"Input": input}, outputs={"Out": out})
+    return out
+
+
+def where(condition, x=None, y=None):
+    if x is None or y is None:
+        # Fluid's one-arg where(condition) returns a data-dependent-length
+        # index tensor — impossible under XLA's static shapes. Use
+        # layers.argsort/topk over a mask, or the ternary form.
+        raise NotImplementedError(
+            "where(condition) with data-dependent output length is not "
+            "supported under XLA static shapes; use where(cond, x, y)."
+        )
+    helper = LayerHelper("where")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("where", inputs={"Condition": condition, "X": x, "Y": y}, outputs={"Out": out})
+    return out
+
+
+def cos_sim(X, Y):
+    helper = LayerHelper("cos_sim")
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xnorm = helper.create_variable_for_type_inference(X.dtype, stop_gradient=True)
+    ynorm = helper.create_variable_for_type_inference(X.dtype, stop_gradient=True)
+    helper.append_op("cos_sim", inputs={"X": X, "Y": Y},
+                     outputs={"Out": out, "XNorm": xnorm, "YNorm": ynorm})
+    return out
+
+
+def dot(x, y, name=None):
+    helper = LayerHelper("dot", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("dot", inputs={"X": x, "Y": y}, outputs={"Out": out})
+    return out
+
+
+def _elementwise_layer(op_type, x, y, axis=-1, act=None, name=None):
+    helper = LayerHelper(op_type, act=act, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(op_type, inputs={"X": x, "Y": y}, outputs={"Out": out}, attrs={"axis": axis})
+    return helper.append_activation(out)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return _elementwise_layer("elementwise_add", x, y, axis, act, name)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return _elementwise_layer("elementwise_sub", x, y, axis, act, name)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return _elementwise_layer("elementwise_mul", x, y, axis, act, name)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return _elementwise_layer("elementwise_div", x, y, axis, act, name)
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return _elementwise_layer("elementwise_max", x, y, axis, act, name)
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return _elementwise_layer("elementwise_min", x, y, axis, act, name)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return _elementwise_layer("elementwise_pow", x, y, axis, act, name)
+
+
+def elementwise_mod(x, y, axis=-1, act=None, name=None):
+    return _elementwise_layer("elementwise_mod", x, y, axis, act, name)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("uniform_random", outputs={"Out": out},
+                     attrs={"shape": list(shape), "dtype": dtype, "min": min, "max": max, "seed": seed})
+    return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("gaussian_random", outputs={"Out": out},
+                     attrs={"shape": list(shape), "dtype": dtype, "mean": mean, "std": std, "seed": seed})
+    return out
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32", input_dim_idx=0,
+                                   output_dim_idx=0, min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("uniform_random_batch_size_like", inputs={"Input": input}, outputs={"Out": out},
+                     attrs={"shape": list(shape), "dtype": dtype, "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx, "min": min, "max": max, "seed": seed})
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0, output_dim_idx=0,
+                                    mean=0.0, std=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("gaussian_random_batch_size_like", inputs={"Input": input}, outputs={"Out": out},
+                     attrs={"shape": list(shape), "dtype": dtype, "input_dim_idx": input_dim_idx,
+                            "output_dim_idx": output_dim_idx, "mean": mean, "std": std, "seed": seed})
+    return out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None, bias_attr=None):
+    helper = LayerHelper("bilinear_tensor_product", act=act, name=name)
+    w = helper.create_parameter(param_attr, shape=[size, x.shape[1], y.shape[1]], dtype=x.dtype)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": x, "Y": y, "Weight": w}
+    if bias_attr is not False:
+        bias = helper.create_parameter(ParamAttr.to_attr(bias_attr), shape=[1, size], dtype=x.dtype, is_bias=True)
+        inputs["Bias"] = bias
+    helper.append_op("bilinear_tensor_product", inputs=inputs, outputs={"Out": out})
+    return helper.append_activation(out)
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", name=name)
+    dim = dim if dim >= 0 else dim + len(input.shape)
+    if isinstance(num_or_sections, int):
+        num = num_or_sections
+        sections = []
+    else:
+        num = 0
+        sections = list(num_or_sections)
+    n_out = num if num else len(sections)
+    outs = [helper.create_variable_for_type_inference(input.dtype) for _ in range(n_out)]
+    helper.append_op("split", inputs={"X": input}, outputs={"Out": outs},
+                     attrs={"num": num, "sections": sections, "axis": dim})
+    return outs
+
+
+def multiplex(inputs, index):
+    helper = LayerHelper("multiplex")
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op("multiplex", inputs={"X": list(inputs), "Ids": index}, outputs={"Out": out})
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32", name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"X": label}
+    if prior_dist is not None:
+        inputs["PriorDist"] = prior_dist
+    helper.append_op("label_smooth", inputs=inputs, outputs={"Out": out},
+                     attrs={"epsilon": float(epsilon)})
+    return out
+
+
+def mean_iou(input, label, num_classes):
+    helper = LayerHelper("mean_iou")
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op("mean_iou", inputs={"Predictions": input, "Labels": label},
+                     outputs={"OutMeanIou": out}, attrs={"num_classes": num_classes})
+    return out
+
+
+def space_to_depth(x, blocksize, name=None):
+    return _single_op_layer("space_to_depth", "space_to_depth", x, {"blocksize": blocksize}, name=name)
+
+
+def shuffle_channel(x, group, name=None):
+    return _single_op_layer("shuffle_channel", "shuffle_channel", x, {"group": group}, name=name)
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Global step counter (reference: layers/nn.py autoincreased_step_counter)."""
+    from .. import initializer as init_mod
+
+    helper = LayerHelper("global_step_counter")
+    name = counter_name or "@STEP_COUNTER@"
+    counter = helper.create_or_get_global_variable(
+        [1], "int64", name, initializer=init_mod.Constant(begin - 1))
+    helper.append_op("increment", inputs={"X": counter}, outputs={"Out": counter},
+                     attrs={"step": float(step)})
+    counter.stop_gradient = True
+    return counter
+
+
+def _pair(x):
+    return tuple(x) if isinstance(x, (list, tuple)) else (x, x)
+
+
+def _triple(x):
+    return tuple(x) if isinstance(x, (list, tuple)) else (x, x, x)

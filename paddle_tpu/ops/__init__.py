@@ -1,0 +1,10 @@
+"""Op implementation library — importing this package registers all ops."""
+
+from . import (  # noqa: F401
+    activation_ops,
+    math_ops,
+    nn_ops,
+    optimizer_ops,
+    reduce_ops,
+    tensor_ops,
+)

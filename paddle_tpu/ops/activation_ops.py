@@ -1,0 +1,171 @@
+"""Activation ops.
+
+Fluid macro-registers ~30 activations (``operators/activation_op.cc:491-510``,
+``activation_op.h:997``) with hand-written forward+grad functors. Here each is
+one jax expression; grads come from JAX autodiff and XLA fuses them into
+adjacent matmuls (the HBM-bandwidth win the reference needs fusion passes for).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import OpContext, register_op
+
+_SIMPLE = {
+    "sigmoid": jax.nn.sigmoid,
+    "logsigmoid": jax.nn.log_sigmoid,
+    "exp": jnp.exp,
+    "relu": jax.nn.relu,
+    "tanh": jnp.tanh,
+    "tanh_shrink": lambda x: x - jnp.tanh(x),
+    "sqrt": jnp.sqrt,
+    "rsqrt": jax.lax.rsqrt,
+    "abs": jnp.abs,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "cos": jnp.cos,
+    "sin": jnp.sin,
+    "round": jnp.round,
+    "reciprocal": jnp.reciprocal,
+    "log": jnp.log,
+    "square": jnp.square,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+}
+
+
+def _make_simple(fn):
+    def impl(ctx: OpContext):
+        ctx.set_output("Out", fn(ctx.input("X")))
+
+    return impl
+
+
+for _name, _fn in _SIMPLE.items():
+    register_op(_name)(_make_simple(_fn))
+
+
+@register_op("gelu")
+def gelu_op(ctx: OpContext):
+    x = ctx.input("X")
+    ctx.set_output("Out", jax.nn.gelu(x, approximate=bool(ctx.attr("approximate", False))))
+
+
+@register_op("leaky_relu")
+def leaky_relu_op(ctx: OpContext):
+    x = ctx.input("X")
+    alpha = ctx.attr("alpha", 0.02)
+    ctx.set_output("Out", jnp.where(x >= 0, x, x * jnp.asarray(alpha, x.dtype)))
+
+
+@register_op("relu6")
+def relu6_op(ctx: OpContext):
+    x = ctx.input("X")
+    ctx.set_output("Out", jnp.clip(x, 0.0, ctx.attr("threshold", 6.0)))
+
+
+@register_op("pow")
+def pow_op(ctx: OpContext):
+    x = ctx.input("X")
+    ctx.set_output("Out", jnp.power(x, jnp.asarray(ctx.attr("factor", 1.0), x.dtype)))
+
+
+@register_op("stanh")
+def stanh_op(ctx: OpContext):
+    x = ctx.input("X")
+    a = ctx.attr("scale_a", 0.67)
+    b = ctx.attr("scale_b", 1.7159)
+    ctx.set_output("Out", b * jnp.tanh(a * x))
+
+
+@register_op("hard_sigmoid")
+def hard_sigmoid_op(ctx: OpContext):
+    x = ctx.input("X")
+    slope = ctx.attr("slope", 0.2)
+    offset = ctx.attr("offset", 0.5)
+    ctx.set_output("Out", jnp.clip(slope * x + offset, 0.0, 1.0))
+
+
+@register_op("swish")
+def swish_op(ctx: OpContext):
+    x = ctx.input("X")
+    beta = ctx.attr("beta", 1.0)
+    ctx.set_output("Out", x * jax.nn.sigmoid(beta * x))
+
+
+@register_op("elu")
+def elu_op(ctx: OpContext):
+    x = ctx.input("X")
+    alpha = ctx.attr("alpha", 1.0)
+    ctx.set_output("Out", jax.nn.elu(x, alpha=alpha))
+
+
+@register_op("selu")
+def selu_op(ctx: OpContext):
+    ctx.set_output("Out", jax.nn.selu(ctx.input("X")))
+
+
+@register_op("brelu")
+def brelu_op(ctx: OpContext):
+    x = ctx.input("X")
+    ctx.set_output("Out", jnp.clip(x, ctx.attr("t_min", 0.0), ctx.attr("t_max", 24.0)))
+
+
+@register_op("soft_relu")
+def soft_relu_op(ctx: OpContext):
+    x = ctx.input("X")
+    t = ctx.attr("threshold", 40.0)
+    ctx.set_output("Out", jnp.log1p(jnp.exp(jnp.clip(x, -t, t))))
+
+
+@register_op("hard_shrink")
+def hard_shrink_op(ctx: OpContext):
+    x = ctx.input("X")
+    t = ctx.attr("threshold", 0.5)
+    ctx.set_output("Out", jnp.where(jnp.abs(x) > t, x, jnp.zeros_like(x)))
+
+
+@register_op("soft_shrink", "softshrink")
+def soft_shrink_op(ctx: OpContext):
+    x = ctx.input("X")
+    lam = ctx.attr("lambda", 0.5)
+    ctx.set_output("Out", jnp.where(x > lam, x - lam, jnp.where(x < -lam, x + lam, jnp.zeros_like(x))))
+
+
+@register_op("thresholded_relu")
+def thresholded_relu_op(ctx: OpContext):
+    x = ctx.input("X")
+    t = ctx.attr("threshold", 1.0)
+    ctx.set_output("Out", jnp.where(x > t, x, jnp.zeros_like(x)))
+
+
+@register_op("prelu")
+def prelu_op(ctx: OpContext):
+    x = ctx.input("X")
+    alpha = ctx.input("Alpha")
+    mode = ctx.attr("mode", "all")
+    if mode == "channel" and alpha.size > 1:
+        alpha = alpha.reshape(1, -1, *([1] * (x.ndim - 2)))
+    elif mode == "all":
+        alpha = alpha.reshape(())
+    ctx.set_output("Out", jnp.where(x >= 0, x, alpha * x))
+
+
+@register_op("maxout")
+def maxout_op(ctx: OpContext):
+    x = ctx.input("X")  # NCHW
+    groups = ctx.attr("groups")
+    n, c, h, w = x.shape
+    ctx.set_output("Out", x.reshape(n, c // groups, groups, h, w).max(axis=2))
+
+
+@register_op("log1p")
+def log1p_op(ctx):
+    ctx.set_output("Out", jnp.log1p(ctx.input("X")))
+
+
+@register_op("erf")
+def erf_op(ctx):
+    ctx.set_output("Out", jax.lax.erf(ctx.input("X")))

@@ -1,0 +1,510 @@
+"""NN ops: softmax/losses, normalization, conv/pool, embedding.
+
+Fluid equivalents: ``operators/softmax_op.cc`` (+cudnn),
+``softmax_with_cross_entropy_op.cc``, ``batch_norm_op.cc``,
+``layer_norm_op.cc``, ``conv_op.cc``/``conv_cudnn_op.cu.cc``,
+``pool_op.cc``, ``lookup_table_op.cc``. Convs lower through
+``lax.conv_general_dilated`` straight onto the MXU — the role cuDNN plays in
+the reference. Data layout is NCHW at the API (Fluid parity); XLA is free to
+relayout internally for the TPU's preferred tiling.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import OpContext, register_op
+
+
+@register_op("softmax")
+def softmax_op(ctx: OpContext):
+    x = ctx.input("X")
+    ctx.set_output("Out", jax.nn.softmax(x, axis=ctx.attr("axis", -1)))
+
+
+@register_op("log_softmax")
+def log_softmax_op(ctx: OpContext):
+    ctx.set_output("Out", jax.nn.log_softmax(ctx.input("X"), axis=ctx.attr("axis", -1)))
+
+
+def _xent_from_probs(probs, label, soft_label, ignore_index=-100):
+    if soft_label:
+        return -jnp.sum(label * jnp.log(jnp.maximum(probs, 1e-20)), axis=-1, keepdims=True)
+    lbl = label.reshape(label.shape[:-1]) if label.shape[-1] == 1 else label
+    lbl = lbl.astype(jnp.int32)
+    picked = jnp.take_along_axis(probs, jnp.maximum(lbl, 0)[..., None], axis=-1)
+    loss = -jnp.log(jnp.maximum(picked, 1e-20))
+    mask = (lbl != ignore_index)[..., None]
+    return jnp.where(mask, loss, jnp.zeros_like(loss))
+
+
+@register_op("cross_entropy", "cross_entropy2")
+def cross_entropy_op(ctx: OpContext):
+    probs = ctx.input("X")
+    label = ctx.input("Label")
+    ctx.set_output(
+        "Y",
+        _xent_from_probs(
+            probs, label, ctx.attr("soft_label", False), ctx.attr("ignore_index", -100)
+        ),
+    )
+
+
+@register_op("softmax_with_cross_entropy")
+def softmax_with_cross_entropy_op(ctx: OpContext):
+    logits = ctx.input("Logits")
+    label = ctx.input("Label")
+    soft_label = ctx.attr("soft_label", False)
+    log_p = jax.nn.log_softmax(logits, axis=-1)
+    if soft_label:
+        loss = -jnp.sum(label * log_p, axis=-1, keepdims=True)
+    else:
+        lbl = label.reshape(label.shape[:-1]) if label.shape[-1] == 1 else label
+        lbl = lbl.astype(jnp.int32)
+        picked = jnp.take_along_axis(log_p, jnp.maximum(lbl, 0)[..., None], axis=-1)
+        ignore = ctx.attr("ignore_index", -100)
+        loss = jnp.where((lbl != ignore)[..., None], -picked, jnp.zeros_like(picked))
+    ctx.set_output("Softmax", jnp.exp(log_p))
+    ctx.set_output("Loss", loss)
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def sigmoid_xent_op(ctx: OpContext):
+    x = ctx.input("X")
+    label = ctx.input("Label")
+    # max(x,0) - x*z + log(1+exp(-|x|)) — numerically stable
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore = ctx.attr("ignore_index", -100)
+    loss = jnp.where(label == ignore, jnp.zeros_like(loss), loss)
+    if ctx.attr("normalize", False):
+        n = jnp.maximum(jnp.sum((label != ignore).astype(x.dtype)), 1.0)
+        loss = loss / n
+    ctx.set_output("Out", loss)
+
+
+@register_op("log_loss")
+def log_loss_op(ctx: OpContext):
+    p = ctx.input("Predicted")
+    y = ctx.input("Labels")
+    eps = ctx.attr("epsilon", 1e-4)
+    ctx.set_output("Loss", -y * jnp.log(p + eps) - (1 - y) * jnp.log(1 - p + eps))
+
+
+@register_op("huber_loss")
+def huber_loss_op(ctx: OpContext):
+    x, y = ctx.input("X"), ctx.input("Y")
+    d = ctx.attr("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= d, 0.5 * r * r, d * (ar - 0.5 * d))
+    ctx.set_output("Residual", r)
+    ctx.set_output("Out", loss)
+
+
+@register_op("smooth_l1_loss")
+def smooth_l1_loss_op(ctx: OpContext):
+    x, y = ctx.input("X"), ctx.input("Y")
+    sigma = ctx.attr("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = x - y
+    if ctx.has_input("InsideWeight"):
+        diff = diff * ctx.input("InsideWeight")
+    ad = jnp.abs(diff)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * s2 * diff * diff, ad - 0.5 / s2)
+    if ctx.has_input("OutsideWeight"):
+        loss = loss * ctx.input("OutsideWeight")
+    ctx.set_output("Diff", diff)
+    ctx.set_output("Out", jnp.sum(loss, axis=tuple(range(1, loss.ndim)), keepdims=False).reshape(x.shape[0], 1))
+
+
+@register_op("hinge_loss")
+def hinge_loss_op(ctx: OpContext):
+    logits, labels = ctx.input("Logits"), ctx.input("Labels")
+    ctx.set_output("Loss", jnp.maximum(1.0 - (2.0 * labels - 1.0) * logits, 0.0))
+
+
+@register_op("rank_loss")
+def rank_loss_op(ctx: OpContext):
+    label = ctx.input("Label")
+    left, right = ctx.input("Left"), ctx.input("Right")
+    d = left - right
+    ctx.set_output("Out", jnp.log1p(jnp.exp(d)) - label * d)
+
+
+@register_op("bpr_loss")
+def bpr_loss_op(ctx: OpContext):
+    x = ctx.input("X")
+    label = ctx.input("Label").reshape(-1).astype(jnp.int32)
+    pos = jnp.take_along_axis(x, label[:, None], axis=-1)
+    diff = x - pos
+    loss = jnp.mean(jnp.log1p(jnp.exp(diff)), axis=-1, keepdims=True)
+    ctx.set_output("Y", loss)
+
+
+@register_op("margin_rank_loss")
+def margin_rank_loss_op(ctx: OpContext):
+    label, x1, x2 = ctx.input("Label"), ctx.input("X1"), ctx.input("X2")
+    margin = ctx.attr("margin", 0.0)
+    out = jnp.maximum(-label * (x1 - x2) + margin, 0.0)
+    ctx.set_output("Out", out)
+    ctx.set_output("Activated", (out > 0).astype(x1.dtype))
+
+
+# -- normalization ------------------------------------------------------------
+
+
+@register_op("batch_norm")
+def batch_norm_op(ctx: OpContext):
+    """Reference: operators/batch_norm_op.cc. NCHW/NHWC via data_layout attr.
+
+    Training: normalize by batch stats; MeanOut/VarianceOut are the running
+    stats updated with momentum (Fluid aliases them onto Mean/Variance — here
+    the functional env rebinds the same names).
+    """
+    x = ctx.input("X")
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    mean, var = ctx.input("Mean"), ctx.input("Variance")
+    eps = ctx.attr("epsilon", 1e-5)
+    momentum = ctx.attr("momentum", 0.9)
+    layout = ctx.attr("data_layout", "NCHW")
+    use_global = ctx.attr("use_global_stats", False) or ctx.is_test
+
+    ch_axis = 1 if layout == "NCHW" else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x.shape[ch_axis]
+
+    cdt = jnp.float32
+    xf = x.astype(cdt)
+    if use_global:
+        use_mean, use_var = mean.astype(cdt), var.astype(cdt)
+        ctx.set_output("MeanOut", mean)
+        ctx.set_output("VarianceOut", var)
+    else:
+        bmean = jnp.mean(xf, axis=reduce_axes)
+        bvar = jnp.mean(jnp.square(xf), axis=reduce_axes) - jnp.square(bmean)
+        use_mean, use_var = bmean, bvar
+        ctx.set_output("MeanOut", (momentum * mean.astype(cdt) + (1 - momentum) * bmean).astype(mean.dtype))
+        ctx.set_output("VarianceOut", (momentum * var.astype(cdt) + (1 - momentum) * bvar).astype(var.dtype))
+        ctx.set_output("SavedMean", bmean.astype(mean.dtype))
+        ctx.set_output("SavedVariance", bvar.astype(var.dtype))
+    inv = jax.lax.rsqrt(use_var + eps)
+    y = (xf - use_mean.reshape(bshape)) * inv.reshape(bshape)
+    y = y * scale.astype(cdt).reshape(bshape) + bias.astype(cdt).reshape(bshape)
+    ctx.set_output("Y", y.astype(x.dtype))
+
+
+@register_op("layer_norm")
+def layer_norm_op(ctx: OpContext):
+    """Reference: operators/layer_norm_op.cc — normalize over dims >= begin_norm_axis."""
+    x = ctx.input("X")
+    axis = ctx.attr("begin_norm_axis", 1)
+    eps = ctx.attr("epsilon", 1e-5)
+    axes = tuple(range(axis, x.ndim))
+    cdt = jnp.float32
+    xf = x.astype(cdt)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    norm_shape = x.shape[axis:]
+    if scale is not None:
+        y = y * scale.astype(cdt).reshape(norm_shape)
+    if bias is not None:
+        y = y + bias.astype(cdt).reshape(norm_shape)
+    ctx.set_output("Y", y.astype(x.dtype))
+    ctx.set_output("Mean", mean.reshape(x.shape[:axis]).reshape(-1))
+    ctx.set_output("Variance", var.reshape(x.shape[:axis]).reshape(-1))
+
+
+@register_op("group_norm")
+def group_norm_op(ctx: OpContext):
+    x = ctx.input("X")  # NCHW
+    groups = ctx.attr("groups")
+    eps = ctx.attr("epsilon", 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape(n, groups, c // groups, *x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    ctx.set_output("Y", y)
+    ctx.set_output("Mean", mean.reshape(n, groups))
+    ctx.set_output("Variance", var.reshape(n, groups))
+
+
+@register_op("instance_norm")
+def instance_norm_op(ctx: OpContext):
+    x = ctx.input("X")
+    eps = ctx.attr("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    if scale is not None:
+        bshape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+        y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    ctx.set_output("Y", y)
+
+
+@register_op("lrn")
+def lrn_op(ctx: OpContext):
+    x = ctx.input("X")  # NCHW
+    n_size = ctx.attr("n", 5)
+    k = ctx.attr("k", 2.0)
+    alpha = ctx.attr("alpha", 1e-4)
+    beta = ctx.attr("beta", 0.75)
+    sq = jnp.square(x)
+    half = n_size // 2
+    pad = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
+    acc = jnp.zeros_like(x)
+    for i in range(n_size):
+        acc = acc + pad[:, i : i + x.shape[1]]
+    mid = k + alpha * acc
+    ctx.set_output("MidOut", mid)
+    ctx.set_output("Out", x / jnp.power(mid, beta))
+
+
+@register_op("data_norm")
+def data_norm_op(ctx: OpContext):
+    x = ctx.input("X")
+    size = ctx.input("BatchSize")
+    bsum = ctx.input("BatchSum")
+    bsq = ctx.input("BatchSquareSum")
+    means = bsum / size
+    scales = jax.lax.rsqrt(bsq / size - jnp.square(means) + 1e-4)
+    ctx.set_output("Means", means)
+    ctx.set_output("Scales", scales)
+    ctx.set_output("Y", (x - means) * scales)
+
+
+@register_op("affine_channel")
+def affine_channel_op(ctx: OpContext):
+    x = ctx.input("X")
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    layout = ctx.attr("data_layout", "NCHW")
+    ch_axis = 1 if layout == "NCHW" else x.ndim - 1
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x.shape[ch_axis]
+    ctx.set_output("Out", x * scale.reshape(bshape) + bias.reshape(bshape))
+
+
+# -- conv / pool --------------------------------------------------------------
+
+
+def _conv_nd(ctx: OpContext, nd: int, transpose: bool = False):
+    x = ctx.input("Input")
+    w = ctx.input("Filter")  # OIHW
+    strides = tuple(ctx.attr("strides", [1] * nd))
+    paddings = ctx.attr("paddings", [0] * nd)
+    dilations = tuple(ctx.attr("dilations", [1] * nd))
+    groups = ctx.attr("groups", 1) or 1
+    pad = [(p, p) for p in paddings]
+    spatial = "DHW"[-nd:]
+    lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, (lhs_spec, rhs_spec, lhs_spec))
+    if not transpose:
+        out = jax.lax.conv_general_dilated(
+            x,
+            w,
+            window_strides=strides,
+            padding=pad,
+            rhs_dilation=dilations,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+        )
+        if out.dtype != x.dtype:
+            out = out.astype(x.dtype)
+    else:
+        # conv_transpose: fluid filter layout is [in_c, out_c/g, H, W]
+        w_t = jnp.swapaxes(w, 0, 1)  # → [out_c/g, in_c, H, W]
+        w_t = jnp.flip(w_t, axis=tuple(range(2, 2 + nd)))
+        out = jax.lax.conv_general_dilated(
+            x,
+            w_t,
+            window_strides=(1,) * nd,
+            padding=[
+                (d * (k - 1) - p, d * (k - 1) - p)
+                for k, p, d in zip(w.shape[2:], paddings, dilations)
+            ],
+            lhs_dilation=strides,
+            rhs_dilation=dilations,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+    ctx.set_output("Output", out)
+
+
+@register_op("conv2d", "depthwise_conv2d")
+def conv2d_op(ctx):
+    _conv_nd(ctx, 2)
+
+
+@register_op("conv3d")
+def conv3d_op(ctx):
+    _conv_nd(ctx, 3)
+
+
+@register_op("conv2d_transpose", "depthwise_conv2d_transpose")
+def conv2d_transpose_op(ctx):
+    _conv_nd(ctx, 2, transpose=True)
+
+
+@register_op("conv3d_transpose")
+def conv3d_transpose_op(ctx):
+    _conv_nd(ctx, 3, transpose=True)
+
+
+def _pool_nd(ctx: OpContext, nd: int):
+    x = ctx.input("X")
+    ptype = ctx.attr("pooling_type", "max")
+    ksize = list(ctx.attr("ksize", [1] * nd))
+    strides = list(ctx.attr("strides", [1] * nd))
+    paddings = list(ctx.attr("paddings", [0] * nd))
+    if ctx.attr("global_pooling", False) or ctx.attr("adaptive", False) and all(k == 1 for k in ksize):
+        axes = tuple(range(2, 2 + nd))
+        red = jnp.max if ptype == "max" else jnp.mean
+        ctx.set_output("Out", red(x, axis=axes, keepdims=True))
+        return
+    window = (1, 1) + tuple(ksize)
+    stride = (1, 1) + tuple(strides)
+    pad = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, stride, pad)
+    else:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride, pad)
+        if ctx.attr("exclusive", True) and any(p > 0 for p in paddings):
+            ones = jnp.ones_like(x)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, stride, pad)
+            out = summed / counts
+        else:
+            out = summed / float(np.prod(ksize))
+    ctx.set_output("Out", out)
+
+
+@register_op("pool2d")
+def pool2d_op(ctx):
+    _pool_nd(ctx, 2)
+
+
+@register_op("pool3d")
+def pool3d_op(ctx):
+    _pool_nd(ctx, 3)
+
+
+# -- embedding ----------------------------------------------------------------
+
+
+@register_op("lookup_table", "lookup_table_v2")
+def lookup_table_op(ctx: OpContext):
+    """Reference: operators/lookup_table_op.cc. Ids [..., 1] int → [..., D].
+
+    Sparse-grad SelectedRows behavior is replaced by dense grads (XLA
+    scatter-add); sharded embeddings live in paddle_tpu/parallel.
+    """
+    w = ctx.input("W")
+    ids = ctx.input("Ids")
+    squeeze_last = ids.ndim > 1 and ids.shape[-1] == 1 and ctx.op.type == "lookup_table"
+    if squeeze_last:
+        ids = ids.reshape(ids.shape[:-1])
+    ids = ids.astype(jnp.int32)
+    padding_idx = ctx.attr("padding_idx", -1)
+    out = jnp.take(w, jnp.maximum(ids, 0), axis=0)
+    out = jnp.where((ids >= 0)[..., None], out, jnp.zeros_like(out))
+    if padding_idx is not None and padding_idx >= 0:
+        out = jnp.where((ids == padding_idx)[..., None], jnp.zeros_like(out), out)
+    ctx.set_output("Out", out)
+
+
+# -- metrics ------------------------------------------------------------------
+
+
+@register_op("accuracy")
+def accuracy_op(ctx: OpContext):
+    """Reference: operators/metrics/accuracy_op.cc — takes top-k Indices + Label."""
+    indices = ctx.input("Indices")
+    label = ctx.input("Label")
+    lbl = label.reshape(-1, 1)
+    correct = jnp.any(indices == lbl, axis=-1)
+    num_correct = jnp.sum(correct.astype(jnp.int32))
+    total = jnp.asarray(lbl.shape[0], jnp.int32)
+    ctx.set_output("Accuracy", num_correct.astype(jnp.float32) / lbl.shape[0])
+    ctx.set_output("Correct", num_correct)
+    ctx.set_output("Total", total)
+
+
+@register_op("auc")
+def auc_op(ctx: OpContext):
+    """Streaming AUC via histogram stats (reference: operators/metrics/auc_op.cc)."""
+    preds = ctx.input("Predict")
+    label = ctx.input("Label").reshape(-1)
+    stat_pos = ctx.input("StatPos")
+    stat_neg = ctx.input("StatNeg")
+    num_buckets = stat_pos.shape[-1]
+    pos_prob = preds[:, 1] if preds.ndim == 2 and preds.shape[1] == 2 else preds.reshape(-1)
+    bucket = jnp.clip((pos_prob * num_buckets).astype(jnp.int32), 0, num_buckets - 1)
+    is_pos = (label > 0).astype(stat_pos.dtype)
+    new_pos = stat_pos.reshape(-1).at[bucket].add(is_pos)
+    new_neg = stat_neg.reshape(-1).at[bucket].add(1 - is_pos)
+    # AUC from histograms: sum over buckets of neg_i * (pos_below + pos_i/2)
+    pos_cum = jnp.cumsum(new_pos) - new_pos
+    auc_sum = jnp.sum(new_neg * (pos_cum + new_pos * 0.5))
+    tot_pos = jnp.sum(new_pos)
+    tot_neg = jnp.sum(new_neg)
+    auc = jnp.where(tot_pos * tot_neg > 0, auc_sum / jnp.maximum(tot_pos * tot_neg, 1.0), 0.0)
+    ctx.set_output("AUC", auc.astype(jnp.float32))
+    ctx.set_output("StatPosOut", new_pos.reshape(stat_pos.shape))
+    ctx.set_output("StatNegOut", new_neg.reshape(stat_neg.shape))
+
+
+@register_op("mean_iou")
+def mean_iou_op(ctx: OpContext):
+    preds = ctx.input("Predictions").reshape(-1)
+    labels = ctx.input("Labels").reshape(-1)
+    num_classes = ctx.attr("num_classes")
+    cm = jnp.zeros((num_classes, num_classes), jnp.float32).at[labels, preds].add(1.0)
+    inter = jnp.diag(cm)
+    union = jnp.sum(cm, 0) + jnp.sum(cm, 1) - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1.0), 0.0)
+    ctx.set_output("OutMeanIou", jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1))
+
+
+# -- interpolation ------------------------------------------------------------
+
+
+def _interp(ctx: OpContext, method: str):
+    x = ctx.input("X")  # NCHW
+    out_h = ctx.attr("out_h", 0)
+    out_w = ctx.attr("out_w", 0)
+    if ctx.has_input("OutSize"):
+        sz = np.asarray(ctx.input("OutSize"))
+        out_h, out_w = int(sz[0]), int(sz[1])
+    scale = ctx.attr("scale", 0.0)
+    if (not out_h or out_h <= 0) and scale:
+        out_h = int(x.shape[2] * scale)
+        out_w = int(x.shape[3] * scale)
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], out_h, out_w), method=method)
+    ctx.set_output("Out", out.astype(x.dtype))
+
+
+@register_op("bilinear_interp")
+def bilinear_interp_op(ctx):
+    _interp(ctx, "bilinear")
+
+
+@register_op("nearest_interp")
+def nearest_interp_op(ctx):
+    _interp(ctx, "nearest")

@@ -1,0 +1,60 @@
+"""Reduce ops (reference: operators/reduce_ops/ — reduce_sum/mean/max/min/prod)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import OpContext, register_op
+
+
+def _reduce(ctx: OpContext, fn):
+    x = ctx.input("X")
+    dims = ctx.attr("dim", [0])
+    keep_dim = ctx.attr("keep_dim", False)
+    if ctx.attr("reduce_all", False):
+        ctx.set_output("Out", fn(x))
+        return
+    axes = tuple(d % x.ndim for d in dims)
+    ctx.set_output("Out", fn(x, axis=axes, keepdims=keep_dim))
+
+
+@register_op("reduce_sum")
+def reduce_sum_op(ctx):
+    _reduce(ctx, jnp.sum)
+
+
+@register_op("reduce_mean")
+def reduce_mean_op(ctx):
+    _reduce(ctx, jnp.mean)
+
+
+@register_op("reduce_max")
+def reduce_max_op(ctx):
+    _reduce(ctx, jnp.max)
+
+
+@register_op("reduce_min")
+def reduce_min_op(ctx):
+    _reduce(ctx, jnp.min)
+
+
+@register_op("reduce_prod")
+def reduce_prod_op(ctx):
+    _reduce(ctx, jnp.prod)
+
+
+@register_op("reduce_all")
+def reduce_all_op(ctx):
+    _reduce(ctx, jnp.all)
+
+
+@register_op("reduce_any")
+def reduce_any_op(ctx):
+    _reduce(ctx, jnp.any)
+
+
+@register_op("logsumexp")
+def logsumexp_op(ctx):
+    from jax.scipy.special import logsumexp
+
+    _reduce(ctx, logsumexp)

@@ -1,0 +1,453 @@
+"""Optimizers (reference: python/paddle/fluid/optimizer.py:44-1484).
+
+Same structure as Fluid: ``minimize`` = ``backward`` (append_backward) +
+``apply_gradients`` (regularization + clip + per-param optimize ops appended
+to the program after the backward marker). The optimize ops are functional
+JAX updates (paddle_tpu/ops/optimizer_ops.py) that XLA fuses into the step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from . import initializer as init_mod
+from .backward import append_backward
+from .clip import append_gradient_clip_ops, error_clip_callback
+from .core import unique_name
+from .core.framework import Parameter, Program, Variable, default_main_program, default_startup_program
+from .layers.layer_helper import LayerHelper
+from .regularizer import append_regularization_ops
+
+__all__ = [
+    "SGD",
+    "Momentum",
+    "Adagrad",
+    "Adam",
+    "Adamax",
+    "AdamW",
+    "DecayedAdagrad",
+    "Adadelta",
+    "RMSProp",
+    "Ftrl",
+    "Lamb",
+    "LarsMomentum",
+    "SGDOptimizer",
+    "MomentumOptimizer",
+    "AdagradOptimizer",
+    "AdamOptimizer",
+    "AdamaxOptimizer",
+    "DecayedAdagradOptimizer",
+    "AdadeltaOptimizer",
+    "RMSPropOptimizer",
+    "FtrlOptimizer",
+    "LambOptimizer",
+    "LarsMomentumOptimizer",
+    "Optimizer",
+]
+
+
+class Optimizer:
+    """Base optimizer (reference: optimizer.py:44)."""
+
+    def __init__(self, learning_rate, regularization=None, name=None):
+        self.regularization = regularization
+        self._name = name
+        self._learning_rate = learning_rate
+        self._learning_rate_var: Optional[Variable] = None
+        # accumulators: {acc_name: {param_name: var}}
+        self._accumulators: Dict[str, Dict[str, Variable]] = {}
+        self.helper: Optional[LayerHelper] = None
+        self.type = getattr(self, "type", "optimizer")
+
+    # -- learning rate --------------------------------------------------------
+    def _create_global_learning_rate(self):
+        if isinstance(self._learning_rate, Variable):
+            self._learning_rate_var = self._learning_rate
+            main = default_main_program()
+            if main._lr_var_name is None:
+                main._lr_var_name = self._learning_rate.name
+            return
+        if self._learning_rate_var is None:
+            from .layers import tensor as tensor_layers
+
+            self._learning_rate_var = tensor_layers.create_global_var(
+                shape=[1],
+                value=float(self._learning_rate),
+                dtype="float32",
+                persistable=True,
+                name=unique_name.generate("learning_rate"),
+            )
+            default_main_program()._lr_var_name = self._learning_rate_var.name
+
+    def _global_learning_rate(self) -> Variable:
+        return self._learning_rate_var
+
+    @property
+    def learning_rate(self):
+        return self._learning_rate
+
+    # -- accumulators ---------------------------------------------------------
+    def _add_accumulator(self, name: str, param: Parameter, dtype=None, fill_value=0.0, shape=None):
+        if name in self._accumulators and param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        acc_name = unique_name.generate("%s_%s_%s" % (param.name, self.type, name))
+        shape = list(shape if shape is not None else param.shape)
+        dtype = dtype or "float32"
+        helper = self.helper
+        var = helper.create_or_get_global_variable(
+            shape, dtype, acc_name, persistable=True,
+            initializer=init_mod.Constant(float(fill_value)))
+        self._accumulators.setdefault(name, {})[param.name] = var
+        return var
+
+    def _get_accumulator(self, name: str, param: Parameter) -> Variable:
+        return self._accumulators[name][param.name]
+
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _finish_update(self, block, parameters_and_grads):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    # -- the Fluid pipeline ---------------------------------------------------
+    def backward(self, loss, startup_program=None, parameter_list=None, no_grad_set=None,
+                 callbacks=None):
+        return append_backward(loss, parameter_list, no_grad_set, callbacks or [error_clip_callback])
+
+    def apply_gradients(self, params_grads: List[Tuple[Parameter, Variable]]):
+        """reference: optimizer.py:318 — clip, regularize, then optimize ops."""
+        params_grads = sorted(params_grads, key=lambda pg: pg[0].name)
+        params_grads = append_gradient_clip_ops(params_grads)
+        params_grads = append_regularization_ops(params_grads, self.regularization)
+        return self._create_optimization_pass(params_grads)
+
+    def _create_optimization_pass(self, parameters_and_grads):
+        """reference: optimizer.py:198."""
+        program = default_main_program()
+        block = program.global_block
+        self.helper = LayerHelper(self.__class__.__name__)
+        self._create_global_learning_rate()
+        self._create_accumulators(block, [p for p, g in parameters_and_grads if g is not None])
+        optimize_ops = []
+        for param_and_grad in parameters_and_grads:
+            if param_and_grad[1] is None:
+                continue
+            if param_and_grad[0].trainable:
+                optimize_ops.append(self._append_optimize_op(block, param_and_grad))
+        self._finish_update(block, parameters_and_grads)
+        return optimize_ops
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        """reference: optimizer.py:357. Ops are appended to the *loss's*
+        program, not whatever default program is active at call time."""
+        from .core.framework import program_guard
+
+        with program_guard(loss.block.program, startup_program):
+            params_grads = self.backward(loss, startup_program, parameter_list, no_grad_set)
+            optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+    def _lr_input(self, param=None):
+        lr = self._global_learning_rate()
+        plr = 1.0
+        if param is not None:
+            plr = getattr(param, "optimize_attr", {"learning_rate": 1.0}).get("learning_rate", 1.0)
+        if plr == 1.0:
+            return lr
+        from .layers import tensor as tensor_layers
+
+        return tensor_layers.scale(lr, scale=float(plr))
+
+
+class SGDOptimizer(Optimizer):
+    type = "sgd"
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "sgd",
+            inputs={"Param": p, "Grad": g, "LearningRate": self._lr_input(p)},
+            outputs={"ParamOut": p},
+        )
+
+
+class MomentumOptimizer(Optimizer):
+    type = "momentum"
+
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p, dtype=p.dtype)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        velocity = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "momentum",
+            inputs={"Param": p, "Grad": g, "Velocity": velocity, "LearningRate": self._lr_input(p)},
+            outputs={"ParamOut": p, "VelocityOut": velocity},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+
+class LarsMomentumOptimizer(MomentumOptimizer):
+    type = "lars_momentum"
+
+    def __init__(self, learning_rate, momentum, lars_coeff=0.001, lars_weight_decay=0.0005, **kw):
+        super().__init__(learning_rate, momentum, **kw)
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        velocity = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "lars_momentum",
+            inputs={"Param": p, "Grad": g, "Velocity": velocity, "LearningRate": self._lr_input(p)},
+            outputs={"ParamOut": p, "VelocityOut": velocity},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay},
+        )
+
+
+class AdagradOptimizer(Optimizer):
+    type = "adagrad"
+
+    def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p, fill_value=self._initial)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        moment = self._get_accumulator("moment", p)
+        return block.append_op(
+            "adagrad",
+            inputs={"Param": p, "Grad": g, "Moment": moment, "LearningRate": self._lr_input(p)},
+            outputs={"ParamOut": p, "MomentOut": moment},
+            attrs={"epsilon": self._epsilon},
+        )
+
+
+class AdamOptimizer(Optimizer):
+    type = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, lazy_mode=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1])
+            self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        b2p = self._get_accumulator("beta2_pow_acc", p)
+        return block.append_op(
+            "adam",
+            inputs={"Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
+                    "Beta1Pow": b1p, "Beta2Pow": b2p, "LearningRate": self._lr_input(p)},
+            outputs={"ParamOut": p, "Moment1Out": m1, "Moment2Out": m2,
+                     "Beta1PowOut": b1p, "Beta2PowOut": b2p},
+            attrs={"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon},
+        )
+
+
+class AdamWOptimizer(AdamOptimizer):
+    type = "adamw"
+
+    def __init__(self, learning_rate=0.001, weight_decay=0.01, **kw):
+        super().__init__(learning_rate, **kw)
+        self._weight_decay = weight_decay
+
+    def _append_optimize_op(self, block, param_and_grad):
+        op = super()._append_optimize_op(block, param_and_grad)
+        op.type = "adamw"
+        op.attrs["weight_decay"] = self._weight_decay
+        return op
+
+
+class AdamaxOptimizer(Optimizer):
+    type = "adamax"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "adamax",
+            inputs={"Param": p, "Grad": g,
+                    "Moment": self._get_accumulator("moment", p),
+                    "InfNorm": self._get_accumulator("inf_norm", p),
+                    "Beta1Pow": self._get_accumulator("beta1_pow_acc", p),
+                    "LearningRate": self._lr_input(p)},
+            outputs={"ParamOut": p,
+                     "MomentOut": self._get_accumulator("moment", p),
+                     "InfNormOut": self._get_accumulator("inf_norm", p),
+                     "Beta1PowOut": self._get_accumulator("beta1_pow_acc", p)},
+            attrs={"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon},
+        )
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    type = "decayed_adagrad"
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        moment = self._get_accumulator("moment", p)
+        return block.append_op(
+            "decayed_adagrad",
+            inputs={"Param": p, "Grad": g, "Moment": moment, "LearningRate": self._lr_input(p)},
+            outputs={"ParamOut": p, "MomentOut": moment},
+            attrs={"decay": self._decay, "epsilon": self._epsilon},
+        )
+
+
+class AdadeltaOptimizer(Optimizer):
+    type = "adadelta"
+
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "adadelta",
+            inputs={"Param": p, "Grad": g,
+                    "AvgSquaredGrad": self._get_accumulator("avg_squared_grad", p),
+                    "AvgSquaredUpdate": self._get_accumulator("avg_squared_update", p),
+                    "LearningRate": self._lr_input(p)},
+            outputs={"ParamOut": p,
+                     "AvgSquaredGradOut": self._get_accumulator("avg_squared_grad", p),
+                     "AvgSquaredUpdateOut": self._get_accumulator("avg_squared_update", p)},
+            attrs={"epsilon": self._epsilon, "rho": self._rho},
+        )
+
+
+class RMSPropOptimizer(Optimizer):
+    type = "rmsprop"
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("momentum", p)
+            if self._centered:
+                self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        inputs = {"Param": p, "Grad": g,
+                  "MeanSquare": self._get_accumulator("mean_square", p),
+                  "Moment": self._get_accumulator("momentum", p),
+                  "LearningRate": self._lr_input(p)}
+        outputs = {"ParamOut": p,
+                   "MeanSquareOut": self._get_accumulator("mean_square", p),
+                   "MomentOut": self._get_accumulator("momentum", p)}
+        if self._centered:
+            inputs["MeanGrad"] = self._get_accumulator("mean_grad", p)
+            outputs["MeanGradOut"] = self._get_accumulator("mean_grad", p)
+        return block.append_op(
+            "rmsprop", inputs=inputs, outputs=outputs,
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum, "centered": self._centered},
+        )
+
+
+class FtrlOptimizer(Optimizer):
+    type = "ftrl"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "ftrl",
+            inputs={"Param": p, "Grad": g,
+                    "SquaredAccumulator": self._get_accumulator("squared", p),
+                    "LinearAccumulator": self._get_accumulator("linear", p),
+                    "LearningRate": self._lr_input(p)},
+            outputs={"ParamOut": p,
+                     "SquaredAccumOut": self._get_accumulator("squared", p),
+                     "LinearAccumOut": self._get_accumulator("linear", p)},
+            attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power},
+        )
+
+
+class LambOptimizer(AdamOptimizer):
+    type = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, **kw):
+        super().__init__(learning_rate, beta1=beta1, beta2=beta2, epsilon=epsilon, **kw)
+        self._weight_decay = lamb_weight_decay
+
+    def _append_optimize_op(self, block, param_and_grad):
+        op = super()._append_optimize_op(block, param_and_grad)
+        op.type = "lamb"
+        op.attrs["weight_decay"] = self._weight_decay
+        return op
+
+
+# Fluid-style short aliases
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+AdamW = AdamWOptimizer
+Adamax = AdamaxOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer
+LarsMomentum = LarsMomentumOptimizer

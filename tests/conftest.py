@@ -1,0 +1,37 @@
+"""Test config: force an 8-device virtual CPU mesh (SURVEY.md §4 implication c).
+
+Tests never require real TPU hardware; sharding/collective tests use the
+virtual devices, numeric tests run on CPU. Set before jax import.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Each test gets fresh default programs + scope + name generator."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import unique_name
+    from paddle_tpu.core.framework import switch_main_program, switch_startup_program
+    from paddle_tpu.core.scope import Scope, scope_guard
+
+    prev_main = switch_main_program(fluid.Program())
+    prev_startup = switch_startup_program(fluid.Program())
+    with unique_name.guard():
+        with scope_guard(Scope()):
+            yield
+    switch_main_program(prev_main)
+    switch_startup_program(prev_startup)
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

@@ -1,0 +1,122 @@
+"""Core IR construction tests (mirrors reference framework tests:
+python/paddle/fluid/tests/unittests/test_program.py, test_operator_desc.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.framework import Program
+
+
+def test_program_blocks_and_vars():
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        assert x.shape == (-1, 4)
+        assert prog.global_block.has_var("x")
+
+
+def test_append_op_and_version_bump():
+    prog = fluid.Program()
+    v0 = prog._version
+    blk = prog.global_block
+    a = blk.create_var(name="a", shape=[2], dtype="float32")
+    b = blk.create_var(name="b", shape=[2], dtype="float32")
+    op = blk.append_op("elementwise_add", inputs={"X": a, "Y": a}, outputs={"Out": b})
+    assert prog._version > v0
+    assert op.input("X") == ["a"]
+    assert op.output("Out") == ["b"]
+    assert blk.ops[-1] is op
+
+
+def test_default_programs_and_guard():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        assert fluid.default_main_program() is main
+        assert fluid.default_startup_program() is startup
+    assert fluid.default_main_program() is not main
+
+
+def test_parameter_creation_appends_init_op():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        out = fluid.layers.fc(x, size=3)
+        params = main.all_parameters()
+        assert len(params) == 2  # weight + bias
+        # init ops live in the startup program
+        assert len(startup.global_block.ops) == 2
+        init_types = {op.type for op in startup.global_block.ops}
+        assert "uniform_random" in init_types  # Xavier default
+        assert "fill_constant" in init_types  # bias zero-fill
+
+
+def test_clone_for_test_strips_optimizer_ops():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        out = fluid.layers.fc(x, size=3, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(out, y))
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    main_types = [op.type for op in main.global_block.ops]
+    test_types = [op.type for op in test_prog.global_block.ops]
+    assert "sgd" in main_types
+    assert "backward_marker" in main_types
+    assert "sgd" not in test_types
+    assert "backward_marker" not in test_types
+
+
+def test_variable_operator_overloading():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data("x", shape=[4])
+        z = x * 2.0 + 1.0
+        types = [op.type for op in main.global_block.ops]
+        assert "scale" in types
+
+
+def test_unknown_op_reports_cleanly():
+    from paddle_tpu.core.registry import get_op_impl
+
+    with pytest.raises(NotImplementedError, match="no TPU implementation"):
+        get_op_impl("definitely_not_an_op")
+
+
+def test_minimize_outside_guard_updates_loss_program():
+    """Regression: optimize ops must land in the loss's program even when
+    minimize() is called outside the program_guard."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        out = fluid.layers.fc(x, size=3)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(out, y)
+        )
+    fluid.optimizer.SGD(0.1).minimize(loss)  # outside the guard
+    assert "sgd" in [op.type for op in main.global_block.ops]
+    assert "sgd" not in [op.type for op in fluid.default_main_program().global_block.ops]
+
+
+def test_clone_for_test_with_regularizer_runs():
+    """Regression: clone(for_test) must drop post-marker clip/regularizer ops."""
+    import paddle_tpu.regularizer as reg
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        out = fluid.layers.fc(x, size=3)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(out, y))
+        fluid.optimizer.Adam(1e-3, regularization=reg.L2Decay(1e-4)).minimize(loss)
+        test_prog = main.clone(for_test=True)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = np.zeros((4, 4), "float32")
+    ys = np.zeros((4, 1), "int64")
+    (train_l,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    (test_l,) = exe.run(test_prog, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    assert np.isfinite(test_l).all()

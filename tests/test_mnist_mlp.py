@@ -1,0 +1,97 @@
+"""The v0 gate (SURVEY.md §7 stage 2): MNIST-style MLP trains to convergence.
+
+Mirrors the reference's book test
+(python/paddle/fluid/tests/book/test_recognize_digits.py) with synthetic
+separable data standing in for MNIST downloads (zero egress).
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _synthetic_mnist(rng, n=512, dim=64, classes=10):
+    """Linearly-separable clusters — a convergence smoke without downloads."""
+    centers = rng.randn(classes, dim).astype("float32") * 3.0
+    ys = rng.randint(0, classes, size=n)
+    xs = centers[ys] + rng.randn(n, dim).astype("float32") * 0.5
+    return xs.astype("float32"), ys.reshape(n, 1).astype("int64")
+
+
+def _build_mlp(dim=64, classes=10):
+    img = fluid.layers.data("img", shape=[dim])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(img, size=128, act="relu")
+    h = fluid.layers.fc(h, size=64, act="relu")
+    logits = fluid.layers.fc(h, size=classes)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label)
+    )
+    acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+    return img, label, loss, acc
+
+
+def test_mnist_mlp_converges(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img, label, loss, acc = _build_mlp()
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+
+    xs, ys = _synthetic_mnist(rng)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    batch = 64
+    first_loss = None
+    last_loss = last_acc = None
+    for epoch in range(6):
+        for i in range(0, len(xs), batch):
+            feed = {"img": xs[i : i + batch], "label": ys[i : i + batch]}
+            last_loss, last_acc = exe.run(main, feed=feed, fetch_list=[loss, acc])
+            if first_loss is None:
+                first_loss = float(last_loss)
+    assert float(last_loss) < 0.25, f"did not converge: {first_loss} -> {float(last_loss)}"
+    assert float(last_acc) > 0.9
+    assert float(first_loss) > float(last_loss)
+
+
+def test_mnist_mlp_sgd_and_momentum(rng):
+    for make_opt in (
+        lambda: fluid.optimizer.SGD(learning_rate=0.1),
+        lambda: fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9),
+    ):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                img, label, loss, acc = _build_mlp()
+                make_opt().minimize(loss)
+        xs, ys = _synthetic_mnist(rng, n=256)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = []
+        for epoch in range(4):
+            for i in range(0, len(xs), 64):
+                feed = {"img": xs[i : i + 64], "label": ys[i : i + 64]}
+                (l,) = exe.run(main, feed=feed, fetch_list=[loss])
+                losses.append(float(l))
+        assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]
+
+
+def test_inference_clone_matches_train_forward(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[8])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(img, size=16, act="relu")
+        logits = fluid.layers.fc(h, size=4)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(logits, label))
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.SGD(0.0).minimize(loss)  # lr=0 → params frozen
+
+    xs = rng.randn(16, 8).astype("float32")
+    ys = rng.randint(0, 4, size=(16, 1)).astype("int64")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    train_logits, = exe.run(main, feed={"img": xs, "label": ys}, fetch_list=[logits])
+    infer_logits, = exe.run(test_prog, feed={"img": xs, "label": ys}, fetch_list=[logits])
+    np.testing.assert_allclose(train_logits, infer_logits, rtol=1e-5, atol=1e-5)
