@@ -279,9 +279,20 @@ class Executor:
                 self._cache[key] = compiled
 
         rng_key = self._rng_key(program)
-        dev = get_device(self.place)
-        if dev is not None and feeds:
-            feeds = {k: jax.device_put(v, dev) for k, v in feeds.items()}
+        if mesh is not None:
+            # Replicate state across the mesh (the Fluid BCastParamsToDevices
+            # moment, parallel_executor.cc:340) and shard feeds on the data
+            # axis. No-op when already laid out correctly.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            repl = NamedSharding(mesh, P())
+            batch_sh = NamedSharding(mesh, P("data"))
+            state = {k: jax.device_put(v, repl) for k, v in state.items()}
+            feeds = {k: jax.device_put(v, batch_sh) for k, v in feeds.items()}
+        else:
+            dev = get_device(self.place)
+            if dev is not None and feeds:
+                feeds = {k: jax.device_put(v, dev) for k, v in feeds.items()}
         new_state, fetches = compiled(state, feeds, rng_key)
 
         for n, v in new_state.items():

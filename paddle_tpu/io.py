@@ -1,0 +1,196 @@
+"""Checkpoint / model IO (reference: python/paddle/fluid/io.py:92-1015).
+
+Fluid builds tiny save/load programs of ``save``/``load_combine`` ops
+(``operators/save_op.cc``, ``load_combine_op.cc:143``) that serialize
+LoDTensors. The TPU-native equivalent serializes the scope's pytree state
+directly (numpy .npz — host-side, no device round trip besides D2H), and the
+inference artifact is the pruned Program's JSON desc plus its params —
+the role ``save_inference_model`` plays in Fluid.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .core import serialization
+from .core.framework import Parameter, Program, Variable, default_main_program
+from .core.scope import Scope, global_scope
+
+__all__ = [
+    "save_vars",
+    "save_params",
+    "save_persistables",
+    "load_vars",
+    "load_params",
+    "load_persistables",
+    "save_inference_model",
+    "load_inference_model",
+]
+
+_COMBINED_DEFAULT = "__params__"
+_MODEL_FILENAME = "__model__"
+
+
+def _target_vars(main_program: Optional[Program], predicate) -> List[Variable]:
+    program = main_program or default_main_program()
+    out = []
+    seen = set()
+    for v in program.list_vars():
+        if v.name in seen:
+            continue
+        if predicate(v):
+            out.append(v)
+            seen.add(v.name)
+    return out
+
+
+def _is_persistable(v: Variable) -> bool:
+    return v.persistable and not v.is_data
+
+
+def _is_parameter(v: Variable) -> bool:
+    return isinstance(v, Parameter)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None, filename=None):
+    """reference: io.py:92 — saves to one .npy per var, or a combined .npz."""
+    scope = global_scope()
+    if vars is None:
+        vars = _target_vars(main_program, predicate or _is_persistable)
+    os.makedirs(dirname, exist_ok=True)
+    arrays = {}
+    for v in vars:
+        name = v.name if isinstance(v, Variable) else str(v)
+        val = scope.find_var(name)
+        if val is None:
+            raise RuntimeError("save_vars: %r not found in scope (run startup first)" % name)
+        arrays[name] = np.asarray(val)
+    if filename is None:
+        for name, arr in arrays.items():
+            np.save(os.path.join(dirname, name.replace("/", "__") + ".npy"), arr)
+        index = {"vars": sorted(arrays), "combined": None}
+    else:
+        np.savez(os.path.join(dirname, filename + ".npz"), **arrays)
+        index = {"vars": sorted(arrays), "combined": filename}
+    with open(os.path.join(dirname, "__index__.json"), "w") as f:
+        json.dump(index, f)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    """reference: io.py save_params — trainable Parameters only."""
+    save_vars(executor, dirname, main_program, predicate=_is_parameter, filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """reference: io.py:441 — all persistables (params + optimizer state +
+    BN stats + counters), sufficient for exact training resume."""
+    save_vars(executor, dirname, main_program, predicate=_is_persistable, filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None, filename=None):
+    """reference: io.py load_vars."""
+    scope = global_scope()
+    with open(os.path.join(dirname, "__index__.json")) as f:
+        index = json.load(f)
+    if vars is not None:
+        wanted = [v.name if isinstance(v, Variable) else str(v) for v in vars]
+    elif predicate is not None or main_program is not None:
+        wanted = [v.name for v in _target_vars(main_program, predicate or _is_persistable)]
+    else:
+        wanted = index["vars"]
+    if index.get("combined"):
+        data = np.load(os.path.join(dirname, index["combined"] + ".npz"))
+        store = {n: data[n] for n in data.files}
+    else:
+        store = None
+    missing = []
+    for name in wanted:
+        if store is not None:
+            if name not in store:
+                missing.append(name)
+                continue
+            arr = store[name]
+        else:
+            path = os.path.join(dirname, name.replace("/", "__") + ".npy")
+            if not os.path.exists(path):
+                missing.append(name)
+                continue
+            arr = np.load(path)
+        scope.set_var(name, arr)
+    if missing:
+        raise RuntimeError("load_vars: missing from checkpoint: %s" % missing)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=_is_parameter, filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """reference: io.py:658."""
+    load_vars(executor, dirname, main_program, predicate=_is_persistable, filename=filename)
+
+
+# -- program pruning ----------------------------------------------------------
+
+
+def prune_program(program: Program, feed_names: Sequence[str], target_names: Sequence[str]) -> Program:
+    """Reverse-reachability prune of block 0 to the feed→target subgraph
+    (reference: framework/prune.cc via Program._prune)."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block
+    needed = set(target_names)
+    kept = []
+    for op in reversed(block.ops):
+        if any(o in needed for o in op.output_arg_names):
+            kept.append(op)
+            needed.update(op.input_arg_names)
+    kept.reverse()
+    block.ops = kept
+    referenced = set(feed_names) | set(target_names)
+    for op in kept:
+        referenced.update(op.input_arg_names)
+        referenced.update(op.output_arg_names)
+    block.vars = {n: v for n, v in block.vars.items() if n in referenced}
+    pruned._version += 1
+    return pruned
+
+
+def save_inference_model(
+    dirname,
+    feeded_var_names: Sequence[str],
+    target_vars: Sequence[Variable],
+    executor,
+    main_program: Optional[Program] = None,
+    model_filename: Optional[str] = None,
+    params_filename: Optional[str] = None,
+    export_for_deployment: bool = True,
+):
+    """reference: io.py:863 — prunes to the inference subgraph, embeds
+    feed/fetch names, and saves the params the subgraph needs."""
+    program = main_program or default_main_program()
+    target_names = [v.name if isinstance(v, Variable) else str(v) for v in target_vars]
+    pruned = prune_program(program, feeded_var_names, target_names)
+    desc = serialization.program_to_desc(pruned)
+    desc["feed_names"] = list(feeded_var_names)
+    desc["fetch_names"] = target_names
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, model_filename or _MODEL_FILENAME), "w") as f:
+        json.dump(desc, f)
+    needed_params = [
+        v for v in pruned.global_block.vars.values() if v.persistable and not v.is_data
+    ]
+    save_vars(executor, dirname, vars=needed_params, filename=params_filename or _COMBINED_DEFAULT)
+    return target_names
+
+
+def load_inference_model(dirname, executor, model_filename=None, params_filename=None):
+    """reference: io.py:1015 — returns (program, feed_names, fetch_names)."""
+    with open(os.path.join(dirname, model_filename or _MODEL_FILENAME)) as f:
+        desc = json.load(f)
+    program = serialization.desc_to_program(desc)
+    load_vars(executor, dirname, vars=None, filename=params_filename or _COMBINED_DEFAULT)
+    return program, desc.get("feed_names", []), desc.get("fetch_names", [])

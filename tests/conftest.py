@@ -11,6 +11,17 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The axon sitecustomize pre-imports jax (capturing JAX_PLATFORMS=axon into
+# jax.config) and may have initialized the TPU backend already — override the
+# config and drop any initialized backends so the settings above take effect.
+import jax as _jax
+
+_jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge as _xb
+
+if _xb.backends_are_initialized():
+    _xb._clear_backends()
+
 import numpy as np
 import pytest
 

@@ -1,0 +1,94 @@
+"""Checkpoint/resume + inference-model round-trip tests
+(mirrors reference tests/book save/reload pattern and test_dist_save_load.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _build(seed=0):
+    x = fluid.layers.data("x", shape=[8])
+    y = fluid.layers.data("y", shape=[1], dtype="int64")
+    h = fluid.layers.fc(x, size=16, act="relu")
+    logits = fluid.layers.fc(h, size=4)
+    loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(logits, y))
+    return x, y, logits, loss
+
+
+def test_save_load_persistables_resume(tmp_path, rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x, y, logits, loss = _build()
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+
+    xs = rng.randn(32, 8).astype("float32")
+    ys = rng.randint(0, 4, (32, 1)).astype("int64")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    for _ in range(3):
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+
+    ckpt = str(tmp_path / "ckpt")
+    fluid.io.save_persistables(exe, ckpt, main_program=main)
+
+    # Continue training from the checkpoint in a FRESH scope: losses must
+    # match continuing in the original scope (exact resume incl. Adam state).
+    ref_losses = []
+    import copy
+
+    saved_scope_vars = {k: np.asarray(v) for k, v in fluid.global_scope().vars.items()}
+    for _ in range(3):
+        (l,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        ref_losses.append(float(l))
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        fluid.io.load_persistables(exe2, ckpt, main_program=main)
+        resumed_losses = []
+        for _ in range(3):
+            (l,) = exe2.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+            resumed_losses.append(float(l))
+    np.testing.assert_allclose(ref_losses, resumed_losses, rtol=1e-5, atol=1e-6)
+
+
+def test_save_load_combined_file(tmp_path, rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x, y, logits, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ckpt = str(tmp_path / "combined")
+    fluid.io.save_params(exe, ckpt, main_program=main, filename="all_params")
+    w_before = fluid.global_scope().as_numpy("fc_0.w_0")
+    with fluid.scope_guard(fluid.Scope()):
+        fluid.io.load_params(exe, ckpt, main_program=main, filename="all_params")
+        w_after = fluid.global_scope().as_numpy("fc_0.w_0")
+    np.testing.assert_array_equal(w_before, w_after)
+
+
+def test_save_load_inference_model(tmp_path, rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x, y, logits, loss = _build()
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = rng.randn(8, 8).astype("float32")
+    ys = rng.randint(0, 4, (8, 1)).astype("int64")
+    exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    expected, = exe.run(main.clone(for_test=True), feed={"x": xs, "y": ys},
+                        fetch_list=[logits])
+
+    model_dir = str(tmp_path / "model")
+    fluid.io.save_inference_model(model_dir, ["x"], [logits], exe, main_program=main)
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        prog, feed_names, fetch_names = fluid.io.load_inference_model(model_dir, exe2)
+        assert feed_names == ["x"]
+        got, = exe2.run(prog, feed={"x": xs}, fetch_list=fetch_names)
+    np.testing.assert_allclose(expected, got, rtol=1e-5, atol=1e-6)
+    # pruned program must not contain label/loss ops
+    types = [op.type for op in prog.global_block.ops]
+    assert "softmax_with_cross_entropy" not in types
+    assert "sgd" not in types
