@@ -1,0 +1,80 @@
+"""Benchmark harness (reference: benchmark/fluid/fluid_benchmark.py).
+
+Reports the reference harness's metric — train ``examples/sec`` with warmup
+exclusion (``--skip_batch_num`` semantics, args.py:40) — for the flagship
+Transformer-base training step on the available accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline: the reference repo publishes no numeric tables
+(BASELINE.md — "published: {}"), so the ratio is against the round-1
+measurement of this framework recorded below once available.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# Round-1 reference point (examples/sec on a single TPU v5e chip), filled in
+# after the first recorded run so later rounds report progress against it.
+ROUND1_BASELINE_EXAMPLES_PER_SEC = 204.15  # 2026-07-29, single TPU v5e chip, fp32
+
+
+def main():
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer as tfm
+
+    batch, seq, vocab = 64, 256, 30000
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        src = fluid.layers.data("src", shape=[seq], dtype="int64")
+        trg = fluid.layers.data("trg", shape=[seq], dtype="int64")
+        lbl = fluid.layers.data("lbl", shape=[seq, 1], dtype="int64")
+        smask = fluid.layers.data("smask", shape=[seq], dtype="float32")
+        tmask = fluid.layers.data("tmask", shape=[seq], dtype="float32")
+        logits, loss = tfm.transformer_base(
+            src, trg, lbl, smask, tmask, src_vocab_size=vocab,
+            trg_vocab_size=vocab, max_length=seq, dropout_rate=0.1)
+        fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss)
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    feed = {
+        "src": rng.randint(2, vocab, (batch, seq)).astype("int64"),
+        "trg": rng.randint(2, vocab, (batch, seq)).astype("int64"),
+        "lbl": rng.randint(2, vocab, (batch, seq, 1)).astype("int64"),
+        "smask": np.ones((batch, seq), "float32"),
+        "tmask": np.ones((batch, seq), "float32"),
+    }
+
+    skip_batch_num, num_batches = 3, 10
+    for _ in range(skip_batch_num):  # warmup incl. compile
+        exe.run(main_prog, feed=feed, fetch_list=[loss])
+    t0 = time.time()
+    for _ in range(num_batches):
+        lv, = exe.run(main_prog, feed=feed, fetch_list=[loss])
+    elapsed = time.time() - t0
+    examples_per_sec = batch * num_batches / elapsed
+
+    vs = (examples_per_sec / ROUND1_BASELINE_EXAMPLES_PER_SEC
+          if ROUND1_BASELINE_EXAMPLES_PER_SEC else 1.0)
+    print(json.dumps({
+        "metric": "transformer_base_train_examples_per_sec_b%d_s%d" % (batch, seq),
+        "value": round(examples_per_sec, 2),
+        "unit": "examples/sec",
+        "vs_baseline": round(vs, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

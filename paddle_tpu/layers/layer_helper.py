@@ -157,6 +157,12 @@ class LayerHelper:
         return self.input(name).dtype
 
     def append_bias_op(self, input_var, dim_start=1, dim_end=None):
+        if input_var.shape is None:
+            raise ValueError(
+                "shape inference failed for %r (produced by op %r) — the layer "
+                "geometry is likely invalid (e.g. spatial dims shrank to zero)"
+                % (input_var.name, input_var.op.type if input_var.op else None)
+            )
         size = list(input_var.shape[dim_start:dim_end])
         bias_attr = ParamAttr.to_attr(self.kwargs.get("bias_attr"))
         if not bias_attr.trainable and bias_attr.name is None and self.kwargs.get("bias_attr") is False:

@@ -141,6 +141,12 @@ def fc(
     mul_results = []
     for inp, pattr in zip(inputs, param_attrs):
         input_shape = inp.shape
+        if input_shape is None:
+            raise ValueError(
+                "fc: input %r has unknown shape (shape inference failed on the "
+                "producing op %r) — check the upstream layer geometry"
+                % (inp.name, inp.op.type if inp.op else None)
+            )
         import numpy as _np
 
         in_features = int(_np.prod([d for d in input_shape[num_flatten_dims:]]))
@@ -728,6 +734,31 @@ def pow(x, factor=1.0, name=None):
 
 def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
     return _act("stanh", x, {"scale_a": scale_a, "scale_b": scale_b}, name=name)
+
+
+# Auto-generated unary layers (the role of layer_function_generator.py /
+# ops.py in the reference — one python wrapper per registered activation op).
+_GENERATED_UNARY = [
+    "square", "sqrt", "rsqrt", "exp", "log", "log1p", "abs", "ceil", "floor",
+    "cos", "sin", "round", "reciprocal", "softplus", "softsign", "logsigmoid",
+    "tanh_shrink", "soft_shrink", "hard_shrink", "thresholded_relu", "selu",
+    "erf", "sign",
+]
+
+
+def _make_unary_layer(op_type):
+    def _layer(x, name=None):
+        return _act(op_type, x, name=name)
+
+    _layer.__name__ = op_type
+    _layer.__doc__ = "Elementwise %s (auto-generated wrapper over the %s op)." % (op_type, op_type)
+    return _layer
+
+
+for _op_name in _GENERATED_UNARY:
+    if _op_name not in globals():
+        globals()[_op_name] = _make_unary_layer(_op_name)
+__all__ += [n for n in _GENERATED_UNARY if n not in __all__]
 
 
 def prelu(x, mode="all", param_attr=None, name=None):

@@ -1,0 +1,5 @@
+"""Model zoo mirroring the reference benchmark configs
+(reference: benchmark/fluid/models/ — mnist, resnet, machine_translation;
+plus BERT and DeepFM from BASELINE.json's five workloads)."""
+
+from . import deepfm, mnist, resnet, transformer  # noqa: F401

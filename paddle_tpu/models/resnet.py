@@ -1,0 +1,87 @@
+"""ResNet family (reference: benchmark/fluid/models/resnet.py and
+benchmark/fluid/models/se_resnext.py).
+
+Built from the framework's conv2d/batch_norm/pool2d layers; everything
+compiles into one XLA program where conv+BN+relu fuse — the reference needs
+the conv_bn_fuse IR pass (framework/ir/conv_bn_fuse_pass.cc) to get the same
+effect at inference only.
+"""
+
+from __future__ import annotations
+
+from .. import layers
+
+_DEPTH_CFG = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+    152: ("bottleneck", [3, 8, 36, 3]),
+}
+
+
+def conv_bn_layer(input, num_filters, filter_size, stride=1, groups=1, act=None):
+    conv = layers.conv2d(
+        input,
+        num_filters=num_filters,
+        filter_size=filter_size,
+        stride=stride,
+        padding=(filter_size - 1) // 2,
+        groups=groups,
+        bias_attr=False,
+    )
+    return layers.batch_norm(conv, act=act)
+
+
+def _shortcut(input, ch_out, stride):
+    ch_in = input.shape[1]
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(input, ch_out, 1, stride)
+    return input
+
+
+def basic_block(input, num_filters, stride):
+    conv0 = conv_bn_layer(input, num_filters, 3, stride, act="relu")
+    conv1 = conv_bn_layer(conv0, num_filters, 3, 1)
+    short = _shortcut(input, num_filters, stride)
+    return layers.elementwise_add(short, conv1, act="relu")
+
+
+def bottleneck_block(input, num_filters, stride):
+    conv0 = conv_bn_layer(input, num_filters, 1, act="relu")
+    conv1 = conv_bn_layer(conv0, num_filters, 3, stride, act="relu")
+    conv2 = conv_bn_layer(conv1, num_filters * 4, 1)
+    short = _shortcut(input, num_filters * 4, stride)
+    return layers.elementwise_add(short, conv2, act="relu")
+
+
+def resnet(img, label, depth=50, class_num=1000, dataset="imagenet"):
+    """reference: resnet.py resnet_imagenet/resnet_cifar10."""
+    block_kind, counts = _DEPTH_CFG[depth]
+    block_fn = bottleneck_block if block_kind == "bottleneck" else basic_block
+
+    if dataset == "imagenet":
+        conv = conv_bn_layer(img, 64, 7, stride=2, act="relu")
+        conv = layers.pool2d(conv, pool_size=3, pool_stride=2, pool_padding=1)
+    else:  # cifar10: 3x3 stem, no maxpool
+        conv = conv_bn_layer(img, 64, 3, stride=1, act="relu")
+
+    for stage, count in enumerate(counts):
+        num_filters = 64 * (2 ** stage)
+        for i in range(count):
+            stride = 2 if i == 0 and stage > 0 else 1
+            conv = block_fn(conv, num_filters, stride)
+
+    pool = layers.pool2d(conv, pool_type="avg", global_pooling=True)
+    logits = layers.fc(pool, size=class_num)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(layers.softmax(logits), label)
+    return logits, loss, acc
+
+
+def resnet50(img, label, class_num=1000):
+    return resnet(img, label, depth=50, class_num=class_num)
+
+
+def resnet_cifar10(img, label, depth=18, class_num=10):
+    return resnet(img, label, depth=depth, class_num=class_num, dataset="cifar10")

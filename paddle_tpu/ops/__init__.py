@@ -2,6 +2,8 @@
 
 from . import (  # noqa: F401
     activation_ops,
+    attention_ops,
+    compare_ops,
     math_ops,
     nn_ops,
     optimizer_ops,

@@ -1,0 +1,90 @@
+"""Fused attention op.
+
+The reference has NO fused attention — transformer models compose it from
+primitive ops in Python (reference: tests/unittests/dist_transformer.py,
+SURVEY.md §5.7). On TPU the fused kernel is the single most important op for
+transformer throughput: this op lowers to the Pallas TPU flash-attention
+kernel (jax.experimental.pallas.ops.tpu.flash_attention) when running on TPU
+hardware, with an XLA-composed fallback elsewhere (CPU tests, odd shapes,
+attention dropout). Segment-ids support is the XLA-native replacement for
+Fluid's LoD variable-length batching.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import OpContext, register_op
+
+
+@functools.lru_cache(maxsize=1)
+def _flash_fn():
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            SegmentIds,
+            flash_attention,
+        )
+
+        return flash_attention, SegmentIds
+    except Exception:  # pragma: no cover - pallas unavailable
+        return None, None
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() not in ("cpu", "gpu")
+
+
+def _flash_ok(q, k, causal) -> bool:
+    """Shape gates for the Pallas kernel's blocking (seq multiples of 128)."""
+    flash, _ = _flash_fn()
+    if flash is None or not _on_tpu():
+        return False
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    return sq % 128 == 0 and sk % 128 == 0 and q.dtype in (jnp.float32, jnp.bfloat16)
+
+
+def sdpa(q, k, v, bias=None, segment_ids_q=None, segment_ids_kv=None,
+         causal=False, sm_scale=1.0, dropout_rate=0.0, dropout_rng=None):
+    """Scaled dot-product attention over [B, H, S, D] tensors."""
+    use_flash = dropout_rate == 0.0 and _flash_ok(q, k, causal)
+    if use_flash:
+        flash, SegmentIds = _flash_fn()
+        seg = None
+        if segment_ids_q is not None:
+            seg = SegmentIds(q=segment_ids_q, kv=segment_ids_kv)
+        try:
+            return flash(q, k, v, ab=bias, segment_ids=seg, causal=causal, sm_scale=sm_scale)
+        except Exception:
+            pass  # fall back to the composed path below
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm_scale
+    if bias is not None:
+        scores = scores + bias
+    if segment_ids_q is not None:
+        mask = segment_ids_q[:, None, :, None] == segment_ids_kv[:, None, None, :]
+        scores = jnp.where(mask, scores, jnp.full_like(scores, -1e9))
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(cm, scores, jnp.full_like(scores, -1e9))
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = probs * keep.astype(probs.dtype) / (1.0 - dropout_rate)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+@register_op("scaled_dot_product_attention")
+def sdpa_op(ctx: OpContext):
+    q, k, v = ctx.input("Q"), ctx.input("K"), ctx.input("V")
+    bias = ctx.input("Bias")
+    seg_q = ctx.input("SegmentIdsQ")
+    seg_kv = ctx.input("SegmentIdsKV")
+    causal = ctx.attr("causal", False)
+    sm_scale = ctx.attr("sm_scale", 1.0)
+    p = 0.0 if ctx.is_test else ctx.attr("dropout_rate", 0.0)
+    rng = ctx.rng() if p > 0.0 else None
+    ctx.set_output("Out", sdpa(q, k, v, bias, seg_q, seg_kv, causal, sm_scale, p, rng))

@@ -1,0 +1,51 @@
+"""Compare + logical ops (reference: operators/controlflow/compare_op.cc,
+logical_op.cc)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import OpContext, register_op
+
+_CMP = {
+    "less_than": jnp.less,
+    "less_equal": jnp.less_equal,
+    "greater_than": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+}
+
+
+def _make_cmp(fn):
+    def impl(ctx: OpContext):
+        x, y = ctx.input("X"), ctx.input("Y")
+        if x.dtype != y.dtype:
+            y = y.astype(x.dtype)
+        ctx.set_output("Out", fn(x, y))
+
+    return impl
+
+
+for _name, _fn in _CMP.items():
+    register_op(_name)(_make_cmp(_fn))
+
+
+@register_op("logical_and")
+def logical_and_op(ctx):
+    ctx.set_output("Out", jnp.logical_and(ctx.input("X"), ctx.input("Y")))
+
+
+@register_op("logical_or")
+def logical_or_op(ctx):
+    ctx.set_output("Out", jnp.logical_or(ctx.input("X"), ctx.input("Y")))
+
+
+@register_op("logical_xor")
+def logical_xor_op(ctx):
+    ctx.set_output("Out", jnp.logical_xor(ctx.input("X"), ctx.input("Y")))
+
+
+@register_op("logical_not")
+def logical_not_op(ctx):
+    ctx.set_output("Out", jnp.logical_not(ctx.input("X")))

@@ -458,10 +458,12 @@ def auc_op(ctx: OpContext):
     is_pos = (label > 0).astype(stat_pos.dtype)
     new_pos = stat_pos.reshape(-1).at[bucket].add(is_pos)
     new_neg = stat_neg.reshape(-1).at[bucket].add(1 - is_pos)
-    # AUC from histograms: sum over buckets of neg_i * (pos_below + pos_i/2)
-    pos_cum = jnp.cumsum(new_pos) - new_pos
-    auc_sum = jnp.sum(new_neg * (pos_cum + new_pos * 0.5))
+    # AUC = P(score_pos > score_neg): for each neg bucket, count positives in
+    # strictly higher buckets plus half the same-bucket ties.
     tot_pos = jnp.sum(new_pos)
+    pos_below_incl = jnp.cumsum(new_pos)
+    pos_above = tot_pos - pos_below_incl
+    auc_sum = jnp.sum(new_neg * (pos_above + new_pos * 0.5))
     tot_neg = jnp.sum(new_neg)
     auc = jnp.where(tot_pos * tot_neg > 0, auc_sum / jnp.maximum(tot_pos * tot_neg, 1.0), 0.0)
     ctx.set_output("AUC", auc.astype(jnp.float32))

@@ -1,0 +1,148 @@
+"""Tiny-config convergence/run smokes for the five workload families
+(SURVEY.md §4 tier 3 — book tests / parallel-executor model tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.models import deepfm as deepfm_mod
+from paddle_tpu.models import mnist as mnist_mod
+from paddle_tpu.models import resnet as resnet_mod
+from paddle_tpu.models import transformer as tfm_mod
+
+
+def test_lenet_mnist_runs_and_learns(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[1, 28, 28])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        logits, loss, acc = mnist_mod.lenet5(img, label, class_num=4)
+        fluid.optimizer.Adam(2e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    # separable synthetic images: class k has bright quadrant k
+    n = 128
+    ys = rng.randint(0, 4, n)
+    xs = rng.randn(n, 1, 28, 28).astype("float32") * 0.1
+    for i, y in enumerate(ys):
+        r, c = divmod(int(y), 2)
+        xs[i, 0, r * 14 : r * 14 + 14, c * 14 : c * 14 + 14] += 1.0
+    losses = []
+    for _ in range(6):
+        for i in range(0, n, 32):
+            l, = exe.run(main, feed={"img": xs[i:i+32], "label": ys[i:i+32].reshape(-1, 1).astype("int64")},
+                         fetch_list=[loss])
+            losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_resnet_cifar_tiny_step(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 16, 16])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        logits, loss, acc = resnet_mod.resnet_cifar10(img, label, depth=18, class_num=10)
+        fluid.optimizer.Momentum(0.01, 0.9).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = rng.randn(8, 3, 16, 16).astype("float32")
+    ys = rng.randint(0, 10, (8, 1)).astype("int64")
+    l1, = exe.run(main, feed={"img": xs, "label": ys}, fetch_list=[loss])
+    l2, = exe.run(main, feed={"img": xs, "label": ys}, fetch_list=[loss])
+    assert np.isfinite(l1).all() and np.isfinite(l2).all()
+    # BN running stats must have moved off their init values
+    mean0 = fluid.global_scope().as_numpy(
+        [n for n in fluid.global_scope().local_var_names() if n.endswith(".mean_0")][0]
+    )
+    assert np.abs(mean0).sum() > 0
+
+
+def test_transformer_tiny_learns(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    B, S, V = 8, 16, 32
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data("src", shape=[S], dtype="int64", append_batch_size=True)
+        trg = fluid.layers.data("trg", shape=[S], dtype="int64", append_batch_size=True)
+        lbl = fluid.layers.data("lbl", shape=[S, 1], dtype="int64")
+        smask = fluid.layers.data("smask", shape=[S], dtype="float32")
+        tmask = fluid.layers.data("tmask", shape=[S], dtype="float32")
+        logits, loss = tfm_mod.transformer(
+            src, trg, lbl, smask, tmask, src_vocab_size=V, trg_vocab_size=V,
+            max_length=S, n_layer=2, n_head=2, d_model=32, d_inner=64,
+            dropout_rate=0.0, label_smooth_eps=0.0)
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    # copy task: target = source shifted
+    src_np = rng.randint(2, V, (B, S)).astype("int64")
+    feed = {
+        "src": src_np,
+        "trg": np.concatenate([np.ones((B, 1), "int64"), src_np[:, :-1]], axis=1),
+        "lbl": src_np.reshape(B, S, 1),
+        "smask": np.ones((B, S), "float32"),
+        "tmask": np.ones((B, S), "float32"),
+    }
+    losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0]) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_bert_tiny_pretrain_step(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    B, S, V = 4, 16, 64
+    n_mask = 3
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[S], dtype="int64")
+        pos = fluid.layers.data("pos", shape=[S], dtype="int64")
+        sent = fluid.layers.data("sent", shape=[S], dtype="int64")
+        mask = fluid.layers.data("mask", shape=[S], dtype="float32")
+        mpos = fluid.layers.data("mpos", shape=[n_mask], dtype="int64")
+        mlbl = fluid.layers.data("mlbl", shape=[1], dtype="int64")
+        nsp = fluid.layers.data("nsp", shape=[1], dtype="int64")
+        total, mlm_loss, nsp_loss = tfm_mod.bert_pretrain(
+            ids, pos, sent, mask, mpos, mlbl, nsp, vocab_size=V,
+            max_position=S, n_layer=2, n_head=2, d_model=32, d_inner=64,
+            dropout_rate=0.0)
+        fluid.optimizer.Adam(1e-3).minimize(total)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {
+        "ids": rng.randint(0, V, (B, S)).astype("int64"),
+        "pos": np.tile(np.arange(S), (B, 1)).astype("int64"),
+        "sent": np.zeros((B, S), "int64"),
+        "mask": np.ones((B, S), "float32"),
+        "mpos": (np.arange(B)[:, None] * S + np.arange(n_mask)).astype("int64"),
+        "mlbl": rng.randint(0, V, (B * n_mask, 1)).astype("int64"),
+        "nsp": rng.randint(0, 2, (B, 1)).astype("int64"),
+    }
+    t1 = float(exe.run(main, feed=feed, fetch_list=[total])[0])
+    t2 = float(exe.run(main, feed=feed, fetch_list=[total])[0])
+    assert np.isfinite([t1, t2]).all()
+    assert t2 < t1
+
+
+def test_deepfm_learns_and_auc_moves(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    F, DIM = 8, 100
+    with fluid.program_guard(main, startup):
+        sp = fluid.layers.data("sp", shape=[F], dtype="int64")
+        dn = fluid.layers.data("dn", shape=[4], dtype="float32")
+        lbl = fluid.layers.data("lbl", shape=[1], dtype="int64")
+        predict, loss, auc_var = deepfm_mod.deepfm(
+            sp, dn, lbl, sparse_feature_dim=DIM, embedding_size=4,
+            num_fields=F, layer_sizes=(16, 16))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    n = 256
+    ids = rng.randint(0, DIM, (n, F)).astype("int64")
+    dense = rng.randn(n, 4).astype("float32")
+    # label determined by first sparse field parity (embedding-learnable)
+    y = (ids[:, 0] % 2).astype("int64").reshape(-1, 1)
+    losses, aucs = [], []
+    for _ in range(8):
+        for i in range(0, n, 64):
+            l, a = exe.run(main, feed={"sp": ids[i:i+64], "dn": dense[i:i+64],
+                                       "lbl": y[i:i+64]}, fetch_list=[loss, auc_var])
+            losses.append(float(l)); aucs.append(float(a))
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    assert aucs[-1] > 0.55
