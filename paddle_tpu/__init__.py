@@ -17,7 +17,7 @@ Typical use mirrors Fluid:
     loss_val, = exe.run(feed={"x": xb, "y": yb}, fetch_list=[loss])
 """
 
-from . import backward, clip, initializer, io, layers, optimizer, regularizer  # noqa: F401
+from . import backward, clip, initializer, io, layers, optimizer, parallel, regularizer  # noqa: F401
 from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
 from .backward import append_backward  # noqa: F401
 from .core.framework import (  # noqa: F401

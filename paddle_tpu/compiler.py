@@ -54,6 +54,9 @@ class BuildStrategy:
         self.fuse_all_reduce_ops = True
         self.num_trainers = 1
         self.trainer_id = 0
+        # Microbatch gradient accumulation (the reference's
+        # multi_batch_merge_pass); feed batch must divide by it.
+        self.gradient_accumulation_steps = 1
 
 
 class CompiledProgram:
@@ -86,6 +89,22 @@ class CompiledProgram:
         self._places = places
         return self
 
+    def with_mesh(self, axes, loss_name: Optional[str] = None,
+                  build_strategy: Optional[BuildStrategy] = None) -> "CompiledProgram":
+        """General N-D mesh parallelism: ``with_mesh({'data': 4, 'model': 2})``.
+
+        Feeds shard over the ``data`` axis; params follow their
+        ``Variable.sharding`` annotations (see paddle_tpu.parallel) — this is
+        the TP/sharded-embedding path the reference lacks (SURVEY §2.3).
+        """
+        from .parallel.mesh import create_mesh
+
+        self._is_data_parallel = True
+        self._loss_name = loss_name
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._mesh_cache = axes if isinstance(axes, Mesh) else create_mesh(axes)
+        return self
+
     # -- mesh construction ----------------------------------------------------
     def _device_count(self) -> int:
         if self._places is not None:
@@ -103,6 +122,9 @@ class CompiledProgram:
 
     # -- execution (called from Executor.run) ---------------------------------
     def _run(self, executor, feed, fetch_list, scope, return_numpy):
+        accum = 1
+        if self._build_strategy is not None:
+            accum = getattr(self._build_strategy, "gradient_accumulation_steps", 1)
         return executor._run_impl(
             self._program,
             feed=feed,
@@ -110,4 +132,5 @@ class CompiledProgram:
             scope=scope,
             return_numpy=return_numpy,
             mesh=self._mesh(),
+            accumulation_steps=accum,
         )

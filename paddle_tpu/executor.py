@@ -37,12 +37,13 @@ _SKIP_OPS = frozenset({"backward_marker", "feed", "fetch"})
 
 
 class TraceContext:
-    """Per-trace state: RNG derivation, test mode, current op position."""
+    """Per-trace state: RNG derivation, test mode, mesh, current op position."""
 
-    def __init__(self, program: Program, is_test: bool, base_rng):
+    def __init__(self, program: Program, is_test: bool, base_rng, mesh=None):
         self.program = program
         self.is_test = is_test
         self.base_rng = base_rng
+        self.mesh = mesh
         self.current_op_idx = 0
 
     def op_rng(self, ctx: OpContext):
@@ -83,12 +84,14 @@ class _CompiledStep:
 
     def __init__(self, program: Program, feed_names: Tuple[str, ...],
                  fetch_names: Tuple[str, ...], state_names: Tuple[str, ...],
-                 is_test: bool, jit: bool = True, mesh=None):
+                 is_test: bool, jit: bool = True, mesh=None,
+                 accumulation_steps: int = 1):
         self.program = program
         self.feed_names = feed_names
         self.fetch_names = fetch_names
         self.state_names = state_names
         self.is_test = is_test
+        self.mesh = mesh
 
         bw = program._backward_info
         block = program.global_block
@@ -99,9 +102,10 @@ class _CompiledStep:
                 if op.type == "backward_marker":
                     marker_idx = i
                     break
+        accum = max(1, int(accumulation_steps)) if marker_idx is not None else 1
 
         def step(state, feeds, rng_key):
-            trace = TraceContext(program, is_test, rng_key)
+            trace = TraceContext(program, is_test, rng_key, mesh=mesh)
             if bw is None or marker_idx is None:
                 env = dict(state)
                 env.update(feeds)
@@ -115,18 +119,38 @@ class _CompiledStep:
                 fwd_ops = ops[:marker_idx]
                 post_ops = ops[marker_idx + 1 :]
 
-                def fwd(params_in):
+                def fwd(params_in, feeds_in):
                     env = dict(rest)
                     env.update(params_in)
-                    env.update(feeds)
+                    env.update(feeds_in)
                     run_block_ops(fwd_ops, env, trace)
                     loss = jnp.sum(env[loss_name])
                     return loss, env
 
-                (loss_val, env), grads = jax.value_and_grad(fwd, has_aux=True)(params)
+                if accum == 1:
+                    (loss_val, env), grads = jax.value_and_grad(
+                        fwd, has_aux=True)(params, feeds)
+                else:
+                    # Gradient accumulation (the reference's multi_batch_merge
+                    # pass, ir/multi_batch_merge_pass.cc): split the feed batch
+                    # into microbatches, average grads before the optimizer.
+                    grads = None
+                    loss_sum = None
+                    for i in range(accum):
+                        sub = {
+                            n: v.reshape((accum, v.shape[0] // accum) + v.shape[1:])[i]
+                            for n, v in feeds.items()
+                        }
+                        (li, env), gi = jax.value_and_grad(
+                            fwd, has_aux=True)(params, sub)
+                        grads = gi if grads is None else jax.tree_util.tree_map(
+                            jnp.add, grads, gi)
+                        loss_sum = li if loss_sum is None else loss_sum + li
+                    grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
+                    env[loss_name] = loss_sum / accum
                 for p in param_names:
                     env[param_to_grad[p]] = grads[p]
-                env[grad_var_name(loss_name)] = jnp.ones_like(loss_val)
+                env[grad_var_name(loss_name)] = jnp.ones_like(jnp.sum(env[loss_name]))
                 run_block_ops(post_ops, env, trace, offset=marker_idx + 1)
 
             new_state = {}
@@ -139,10 +163,13 @@ class _CompiledStep:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             repl = NamedSharding(mesh, P())
-            feed_sh = {n: NamedSharding(mesh, P("data")) for n in feed_names}
+            batch_spec = P("data") if "data" in mesh.axis_names else P()
+            feed_sh = {n: NamedSharding(mesh, batch_spec) for n in feed_names}
+            # State shardings come from the arrays themselves (the executor
+            # device_puts them per Variable.sharding annotations).
             self.fn = jax.jit(
                 step,
-                in_shardings=(repl, feed_sh, repl),
+                in_shardings=(None, feed_sh, repl),
                 donate_argnums=(0,),
             )
         elif jit:
@@ -228,6 +255,7 @@ class Executor:
         return_numpy: bool = True,
         use_program_cache: bool = True,
         mesh=None,
+        accumulation_steps: int = 1,
     ):
         if program is None:
             program = default_main_program()
@@ -263,6 +291,7 @@ class Executor:
             avail_state_names,
             is_test,
             id(mesh) if mesh is not None else None,
+            accumulation_steps,
         )
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
@@ -274,20 +303,28 @@ class Executor:
                 is_test=is_test,
                 jit=is_training_or_has_feed,
                 mesh=mesh,
+                accumulation_steps=accumulation_steps,
             )
             if use_program_cache:
                 self._cache[key] = compiled
 
         rng_key = self._rng_key(program)
         if mesh is not None:
-            # Replicate state across the mesh (the Fluid BCastParamsToDevices
-            # moment, parallel_executor.cc:340) and shard feeds on the data
-            # axis. No-op when already laid out correctly.
+            # Lay out state across the mesh: replicated by default (the Fluid
+            # BCastParamsToDevices moment, parallel_executor.cc:340), or per
+            # Variable.sharding annotation (model-parallel params, sharded
+            # embeddings). Feeds shard on the data axis. No-op when already
+            # laid out correctly.
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             repl = NamedSharding(mesh, P())
-            batch_sh = NamedSharding(mesh, P("data"))
-            state = {k: jax.device_put(v, repl) for k, v in state.items()}
+            specs = {}
+            for v in program.list_vars():
+                spec = getattr(v, "sharding", None)
+                if spec is not None and all(a is None or a in mesh.axis_names for a in spec):
+                    specs[v.name] = NamedSharding(mesh, P(*spec))
+            batch_sh = NamedSharding(mesh, P("data") if "data" in mesh.axis_names else P())
+            state = {k: jax.device_put(v, specs.get(k, repl)) for k, v in state.items()}
             feeds = {k: jax.device_put(v, batch_sh) for k, v in feeds.items()}
         else:
             dev = get_device(self.place)

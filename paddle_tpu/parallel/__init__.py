@@ -1,0 +1,9 @@
+from .api import (  # noqa: F401
+    annotate_sharding,
+    column_parallel_fc,
+    get_sharding,
+    row_parallel_fc,
+    sharded_embedding,
+)
+from .distributed import init_distributed  # noqa: F401
+from .mesh import create_mesh, get_mesh, mesh_guard  # noqa: F401

@@ -1,0 +1,143 @@
+"""Sharding annotations + model-parallel layers.
+
+This replaces two reference subsystems with one mechanism:
+- the parameter-server sharded tables (``slice_variable``
+  ``transpiler/distribute_transpiler.py:84``, split_ids/prefetch) → a
+  row-sharded embedding Parameter on the ``model`` mesh axis; GSPMD turns
+  lookups into the gather/all-to-all communication the PS runtime hand-rolled;
+- model parallelism (absent in the reference, SURVEY §2.3 checklist) →
+  column/row-parallel FC via weight sharding annotations.
+
+A Variable's ``sharding`` attr is a PartitionSpec-like tuple of mesh-axis
+names (or None per dim). The Executor turns it into NamedShardings for the
+jitted step's state; a ``shard_constraint`` op pins activations in-graph.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from ..core.framework import Variable
+from ..core.registry import OpContext, register_op
+from ..layers.layer_helper import LayerHelper, ParamAttr
+
+__all__ = [
+    "annotate_sharding",
+    "get_sharding",
+    "shard_constraint",
+    "sharded_embedding",
+    "column_parallel_fc",
+    "row_parallel_fc",
+]
+
+
+def annotate_sharding(var: Variable, spec: Sequence[Optional[str]]) -> Variable:
+    """Mark a persistable var to live sharded over mesh axes, e.g.
+    ('model', None) row-shards a [V, D] table."""
+    var.sharding = tuple(spec)
+    return var
+
+
+def get_sharding(var: Variable):
+    return getattr(var, "sharding", None)
+
+
+@register_op("shard_constraint")
+def shard_constraint_op(ctx: OpContext):
+    import jax
+    from jax.sharding import PartitionSpec
+
+    x = ctx.input("X")
+    spec = PartitionSpec(*ctx.attr("spec"))
+    mesh = ctx.trace.mesh if hasattr(ctx.trace, "mesh") else None
+    if mesh is None:
+        ctx.set_output("Out", x)
+        return
+    from jax.sharding import NamedSharding
+
+    ctx.set_output("Out", jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec)))
+
+
+def shard_constraint(x: Variable, spec: Sequence[Optional[str]], name=None) -> Variable:
+    """In-graph activation sharding pin (jax.lax.with_sharding_constraint)."""
+    helper = LayerHelper("shard_constraint", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("shard_constraint", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"spec": list(spec)})
+    return out
+
+
+def sharded_embedding(input, size, mesh_axis="model", param_attr=None,
+                      dtype="float32", padding_idx=None, name=None):
+    """Embedding with the table row-sharded over ``mesh_axis``.
+
+    The idiomatic replacement of the reference's distributed lookup table
+    (prefetch_op + listen_and_serv sparse path): XLA partitions the gather,
+    each device holds V/n rows in HBM, and the result is all-gathered over
+    ICI — no parameter server.
+    """
+    from .. import layers
+
+    helper = LayerHelper("sharded_embedding", name=name)
+    attr = ParamAttr.to_attr(param_attr)
+    out = layers.embedding(input, size=size, param_attr=attr, dtype=dtype,
+                           padding_idx=padding_idx, name=name)
+    # the embedding layer registered the Parameter; annotate its rows
+    emb_op = out.op
+    w_name = emb_op.input("W")[0]
+    w_var = out.block.var(w_name)
+    annotate_sharding(w_var, (mesh_axis, None))
+    return out
+
+
+def column_parallel_fc(input, size, mesh_axis="model", act=None, param_attr=None,
+                       bias_attr=None, num_flatten_dims=1, name=None):
+    """FC with weight column-sharded: [in, out/n] per device; output stays
+    sharded on its feature dim (pair with row_parallel_fc to close)."""
+    from .. import layers
+
+    out = layers.fc(input, size=size, num_flatten_dims=num_flatten_dims,
+                    param_attr=param_attr, bias_attr=bias_attr, act=act, name=name)
+    _annotate_fc_params(out, col_spec=(None, mesh_axis), bias_spec=(mesh_axis,))
+    return out
+
+
+def row_parallel_fc(input, size, mesh_axis="model", act=None, param_attr=None,
+                    bias_attr=None, num_flatten_dims=1, name=None):
+    """FC with weight row-sharded: [in/n, out] per device; XLA inserts the
+    psum over the contracted dim."""
+    from .. import layers
+
+    out = layers.fc(input, size=size, num_flatten_dims=num_flatten_dims,
+                    param_attr=param_attr, bias_attr=bias_attr, act=act, name=name)
+    _annotate_fc_params(out, col_spec=(mesh_axis, None), bias_spec=(None,))
+    return out
+
+
+def _annotate_fc_params(out_var, col_spec, bias_spec):
+    """Walk back from the fc output to its mul/elementwise_add ops and
+    annotate the weight (and bias) parameters."""
+    block = out_var.block
+    seen = set()
+    frontier = [out_var.name]
+    while frontier:
+        name = frontier.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        var = block._find_var_recursive(name)
+        if var is None or var.op is None:
+            continue
+        op = var.op
+        if op.type == "mul":
+            w = block.var(op.input("Y")[0])
+            annotate_sharding(w, col_spec)
+            continue
+        if op.type == "elementwise_add" and len(op.input("Y")) == 1:
+            maybe_bias = block._find_var_recursive(op.input("Y")[0])
+            from ..core.framework import Parameter
+
+            if isinstance(maybe_bias, Parameter):
+                annotate_sharding(maybe_bias, bias_spec)
+        for slot in op.inputs.values():
+            frontier.extend(slot)

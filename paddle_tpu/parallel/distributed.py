@@ -1,0 +1,44 @@
+"""Multi-host initialization.
+
+Replaces the reference's NCCL2 bootstrap (``gen_nccl_id_op.cc:31`` —
+trainer0 generates an ncclUniqueId and RPC-broadcasts it) and the
+``PADDLE_TRAINER_*`` env protocol (``distribute_transpiler.py``): one call to
+``jax.distributed.initialize`` and every host joins the same global device
+mesh; the same pjit program then spans ICI within a slice and DCN across
+slices with no further code changes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["init_distributed"]
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+):
+    """Initialize multi-host JAX. Args default from the reference's env
+    protocol (PADDLE_TRAINER_ENDPOINTS / PADDLE_TRAINERS_NUM /
+    PADDLE_TRAINER_ID) so reference launch scripts keep working."""
+    import jax
+
+    if coordinator_address is None:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        coordinator_address = eps.split(",")[0] if eps else None
+    if num_processes is None:
+        num_processes = int(os.environ.get("PADDLE_TRAINERS_NUM", "0")) or None
+    if process_id is None:
+        pid = os.environ.get("PADDLE_TRAINER_ID")
+        process_id = int(pid) if pid is not None else None
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    return jax.process_index(), jax.process_count()
