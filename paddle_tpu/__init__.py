@@ -17,7 +17,23 @@ Typical use mirrors Fluid:
     loss_val, = exe.run(feed={"x": xb, "y": yb}, fetch_list=[loss])
 """
 
-from . import backward, clip, initializer, io, layers, optimizer, parallel, regularizer  # noqa: F401
+from . import (  # noqa: F401
+    backward,
+    clip,
+    dataset,
+    debugger,
+    initializer,
+    io,
+    layers,
+    metrics,
+    optimizer,
+    parallel,
+    profiler,
+    reader,
+    regularizer,
+)
+from .data_feeder import DataFeeder  # noqa: F401
+from .flags import flags, get_flag, set_flag  # noqa: F401
 from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
 from .backward import append_backward  # noqa: F401
 from .core.framework import (  # noqa: F401

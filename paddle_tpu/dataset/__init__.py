@@ -1,0 +1,1 @@
+from . import cifar, mnist, uci_housing  # noqa: F401

@@ -332,6 +332,21 @@ class Executor:
                 feeds = {k: jax.device_put(v, dev) for k, v in feeds.items()}
         new_state, fetches = compiled(state, feeds, rng_key)
 
+        from .flags import flags as _flags
+
+        if _flags.benchmark:
+            # per-step device sync (reference: FLAGS_benchmark operator.cc:942)
+            jax.block_until_ready((new_state, fetches))
+        if _flags.check_nan_inf:
+            # post-step NaN/Inf scan (reference: FLAGS_check_nan_inf
+            # operator.cc:947) over fetches + updated state
+            for label, val in list(zip(fetch_names, fetches)) + list(new_state.items()):
+                arr = np.asarray(val)
+                if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
+                    raise RuntimeError(
+                        "FLAGS_check_nan_inf: non-finite values in %r after op "
+                        "execution" % label)
+
         for n, v in new_state.items():
             if v is not None:
                 scope.set_var(n, v)

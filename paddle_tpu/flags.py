@@ -1,0 +1,75 @@
+"""Runtime flag system (reference: gflags DEFINE_* + the FLAGS_* env
+whitelist in python/paddle/fluid/__init__.py:128-160).
+
+Flags are read from ``FLAGS_*`` environment variables at import (the
+``--tryfromenv`` path of init.cc:44) and mutable at runtime via set_flag().
+Only flags that mean something under XLA are wired; the rest are accepted
+and ignored for script compatibility.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+__all__ = ["get_flag", "set_flag", "flags"]
+
+_DEFAULTS: Dict[str, Any] = {
+    # honored
+    "check_nan_inf": False,          # post-step NaN/Inf scan (operator.cc:947)
+    "benchmark": False,              # block_until_ready every step (operator.cc:942)
+    "eager_delete_tensor_gb": 0.0,   # accepted; XLA buffer liveness handles it
+    # accepted for compatibility, no-ops under XLA
+    "fraction_of_gpu_memory_to_use": 0.92,
+    "allocator_strategy": "naive_best_fit",
+    "cpu_deterministic": True,       # XLA is deterministic by construction
+    "sync_nccl_allreduce": False,
+    "paddle_num_threads": 1,
+    "init_allocated_mem": False,
+    "limit_of_tmp_allocation": -1,
+    "rpc_deadline": 180000,
+}
+
+_flags: Dict[str, Any] = {}
+
+
+def _coerce(default, raw: str):
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, float):
+        return float(raw)
+    if isinstance(default, int):
+        return int(raw)
+    return raw
+
+
+def _load_env():
+    for name, default in _DEFAULTS.items():
+        raw = os.environ.get("FLAGS_" + name)
+        _flags[name] = _coerce(default, raw) if raw is not None else default
+
+
+_load_env()
+
+
+def get_flag(name: str):
+    if name not in _flags:
+        raise KeyError("unknown flag %r (known: %s)" % (name, sorted(_flags)))
+    return _flags[name]
+
+
+def set_flag(name: str, value):
+    if name not in _flags:
+        raise KeyError("unknown flag %r" % name)
+    _flags[name] = value
+
+
+class _Flags:
+    def __getattr__(self, name):
+        return get_flag(name)
+
+    def __setattr__(self, name, value):
+        set_flag(name, value)
+
+
+flags = _Flags()

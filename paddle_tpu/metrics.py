@@ -1,0 +1,144 @@
+"""Python-side metric accumulators (reference: python/paddle/fluid/metrics.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["MetricBase", "Accuracy", "Precision", "Recall", "Auc",
+           "EditDistance", "CompositeMetric"]
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = name or self.__class__.__name__
+
+    def reset(self):
+        for k, v in self.__dict__.items():
+            if k.startswith("_"):
+                continue
+            if isinstance(v, (int, float)):
+                setattr(self, k, 0)
+            elif isinstance(v, np.ndarray):
+                setattr(self, k, np.zeros_like(v))
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class Accuracy(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight):
+        self.value += float(value) * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("Accuracy: no updates yet")
+        return self.value / self.weight
+
+
+class Precision(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(int).reshape(-1)
+        labels = np.asarray(labels).astype(int).reshape(-1)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fp += int(np.sum((preds == 1) & (labels == 0)))
+
+    def eval(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+
+class Recall(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(int).reshape(-1)
+        labels = np.asarray(labels).astype(int).reshape(-1)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fn += int(np.sum((preds == 0) & (labels == 1)))
+
+    def eval(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+
+class Auc(MetricBase):
+    """Histogram AUC accumulator matching the in-graph auc op."""
+
+    def __init__(self, name=None, curve="ROC", num_thresholds=4095):
+        super().__init__(name)
+        self._num = num_thresholds + 1
+        self.stat_pos = np.zeros(self._num, dtype=np.float64)
+        self.stat_neg = np.zeros(self._num, dtype=np.float64)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        pos_prob = preds[:, 1] if preds.ndim == 2 and preds.shape[1] == 2 else preds.reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        bucket = np.clip((pos_prob * self._num).astype(int), 0, self._num - 1)
+        for b, l in zip(bucket, labels):
+            if l > 0:
+                self.stat_pos[b] += 1
+            else:
+                self.stat_neg[b] += 1
+
+    def eval(self):
+        tot_pos = self.stat_pos.sum()
+        tot_neg = self.stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        pos_above = tot_pos - np.cumsum(self.stat_pos)
+        auc_sum = np.sum(self.stat_neg * (pos_above + self.stat_pos * 0.5))
+        return float(auc_sum / (tot_pos * tot_neg))
+
+
+class EditDistance(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num=None):
+        distances = np.asarray(distances).reshape(-1)
+        self.total_distance += float(distances.sum())
+        self.seq_num += seq_num if seq_num is not None else len(distances)
+        self.instance_error += int(np.sum(distances > 0))
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError("EditDistance: no updates yet")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)
+
+
+class CompositeMetric(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]

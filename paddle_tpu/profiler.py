@@ -1,0 +1,62 @@
+"""Profiler (reference: python/paddle/fluid/profiler.py:39-221 +
+platform/profiler.cc / device_tracer.cc over CUPTI).
+
+The TPU-native stack: ``jax.profiler`` captures both host events and device
+(TPU) timelines into a trace viewable in TensorBoard/Perfetto — the role the
+reference splits between RecordEvent, CUPTI DeviceTracer, profiler.proto and
+tools/timeline.py. The context-manager UX is kept identical.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Optional
+
+import jax
+
+__all__ = ["profiler", "start_profiler", "stop_profiler", "cuda_profiler",
+           "npu_profiler", "record_event"]
+
+_active_dir: Optional[str] = None
+
+
+def start_profiler(state: str = "All", tracer_option=None, log_dir: Optional[str] = None):
+    """reference: profiler.py:125. state/tracer_option accepted for parity."""
+    global _active_dir
+    _active_dir = log_dir or os.environ.get("PADDLE_TPU_PROFILE_DIR", "/tmp/paddle_tpu_profile")
+    jax.profiler.start_trace(_active_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path: Optional[str] = None):
+    """reference: profiler.py:165. The trace lands in the log dir for
+    TensorBoard/Perfetto instead of a text table."""
+    global _active_dir
+    jax.profiler.stop_trace()
+    d, _active_dir = _active_dir, None
+    return d
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key=None, profile_path: Optional[str] = None,
+             tracer_option=None, log_dir: Optional[str] = None):
+    """reference: profiler.py:221 context manager."""
+    start_profiler(state, tracer_option, log_dir or profile_path)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+# GPU-era aliases kept for API parity; both map to the same TPU trace.
+cuda_profiler = profiler
+npu_profiler = profiler
+
+
+@contextlib.contextmanager
+def record_event(name: str):
+    """RAII scope marker (reference: platform/profiler.h:41 RecordEvent) —
+    shows up as a named range in the trace."""
+    with jax.profiler.TraceAnnotation(name):
+        yield

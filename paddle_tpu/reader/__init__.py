@@ -1,0 +1,12 @@
+from .decorator import (  # noqa: F401
+    batch,
+    buffered,
+    cache,
+    chain,
+    compose,
+    firstn,
+    map_readers,
+    shuffle,
+    xmap_readers,
+)
+from .prefetcher import DevicePrefetcher  # noqa: F401

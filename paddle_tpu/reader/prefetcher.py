@@ -1,0 +1,67 @@
+"""Device prefetcher — the double-buffer reader.
+
+Reference: ``operators/reader/buffered_reader.cc`` (create_double_buffer
+reader: async H2D copy on a dedicated stream) and py_reader's
+``LoDTensorBlockingQueue``. Here a background thread converts + device_puts
+the NEXT feed dict while the current step computes, overlapping host→HBM
+transfer with TPU compute.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["DevicePrefetcher"]
+
+
+class DevicePrefetcher:
+    """Wrap an iterator of feed dicts; yields dicts whose arrays are already
+    on device.
+
+        for feed in DevicePrefetcher(feed_iter(), capacity=2):
+            exe.run(main, feed=feed, fetch_list=[loss])
+    """
+
+    _END = object()
+
+    def __init__(self, feeds: Iterator[Dict[str, np.ndarray]], capacity: int = 2,
+                 device=None, sharding=None):
+        self._src = feeds
+        self._q: queue.Queue = queue.Queue(maxsize=capacity)
+        self._device = device
+        self._sharding = sharding
+        self._thread: Optional[threading.Thread] = None
+        self._err = None
+
+    def _target(self):
+        if self._sharding is not None:
+            return self._sharding
+        if self._device is not None:
+            return self._device
+        return jax.devices()[0]
+
+    def _worker(self):
+        try:
+            tgt = self._target()
+            for feed in self._src:
+                self._q.put({k: jax.device_put(v, tgt) for k, v in feed.items()})
+        except Exception as e:  # propagate into the consumer
+            self._err = e
+        finally:
+            self._q.put(self._END)
+
+    def __iter__(self):
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+        while True:
+            item = self._q.get()
+            if item is self._END:
+                if self._err is not None:
+                    raise self._err
+                return
+            yield item

@@ -1,0 +1,107 @@
+"""Aux subsystem tests: DataFeeder, reader decorators, metrics, flags,
+debugger, datasets, prefetcher (SURVEY §5 parity)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import metrics as M
+from paddle_tpu import reader as R
+
+
+def test_reader_decorators_compose():
+    def r():
+        return iter(range(10))
+
+    batches = list(R.batch(r, 3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+    assert list(R.batch(r, 3, drop_last=True)()) == [[0, 1, 2], [3, 4, 5], [6, 7, 8]]
+    assert sorted(R.shuffle(r, 5, seed=0)()) == list(range(10))
+    assert list(R.firstn(r, 4)()) == [0, 1, 2, 3]
+    doubled = R.map_readers(lambda x: 2 * x, r)
+    assert list(doubled()) == [0, 2, 4, 6, 8, 10, 12, 14, 16, 18]
+    assert list(R.chain(r, r)()) == list(range(10)) * 2
+    assert sorted(R.buffered(r, 2)()) == list(range(10))
+    assert sorted(R.xmap_readers(lambda x: x + 1, r, 2, 4)()) == list(range(1, 11))
+    assert list(R.xmap_readers(lambda x: x + 1, r, 2, 4, order=True)()) == list(range(1, 11))
+
+
+def test_data_feeder_batches_and_pads():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+    feeder = fluid.DataFeeder([x, y])
+    feed = feeder.feed([(np.ones(4, "float32"), 3), (np.zeros(4, "float32"), 1)])
+    assert feed["x"].shape == (2, 4)
+    assert feed["y"].shape == (2, 1) and feed["y"].dtype == np.int64
+
+    seq = fluid.layers.data("s", shape=[-1], dtype="int64", append_batch_size=True)
+    f2 = fluid.DataFeeder([seq], pad_sequences=True, emit_masks=True)
+    feed = f2.feed([(np.array([1, 2, 3]),), (np.array([5]),)])
+    assert feed["s"].shape == (2, 3)
+    np.testing.assert_array_equal(feed["s_mask"], [[1, 1, 1], [1, 0, 0]])
+
+
+def test_metrics_accumulators():
+    acc = M.Accuracy()
+    acc.update(0.5, 10)
+    acc.update(1.0, 10)
+    assert abs(acc.eval() - 0.75) < 1e-9
+
+    auc = M.Auc(num_thresholds=255)
+    preds = np.array([[0.9, 0.1], [0.1, 0.9], [0.2, 0.8], [0.7, 0.3]])
+    labels = np.array([0, 1, 1, 0])
+    auc.update(preds, labels)
+    assert auc.eval() == 1.0  # perfectly separable
+
+    p = M.Precision(); p.update([1, 1, 0], [1, 0, 0])
+    assert abs(p.eval() - 0.5) < 1e-9
+    r = M.Recall(); r.update([1, 0, 0], [1, 1, 0])
+    assert abs(r.eval() - 0.5) < 1e-9
+
+
+def test_flags_env_and_nan_check(rng, monkeypatch):
+    assert fluid.get_flag("check_nan_inf") is False
+    fluid.set_flag("check_nan_inf", True)
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[2])
+            out = fluid.layers.log(x)  # log of negative → nan
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        with pytest.raises(RuntimeError, match="check_nan_inf"):
+            exe.run(main, feed={"x": np.array([[-1.0, 1.0]], "float32")},
+                    fetch_list=[out])
+    finally:
+        fluid.set_flag("check_nan_inf", False)
+
+
+def test_debugger_and_datasets(tmp_path):
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.layers.data("x", shape=[2])
+        y = fluid.layers.fc(x, size=3)
+    text = fluid.debugger.pprint_program_codes(main)
+    assert "mul" in text and "var x" in text
+    dot = fluid.debugger.draw_block_graphviz(main.global_block,
+                                             str(tmp_path / "g.dot"))
+    assert "digraph" in open(dot).read()
+
+    ex = next(fluid.dataset.mnist.train()())
+    assert ex[0].shape == (784,) and 0 <= ex[1] < 10
+    ex = next(fluid.dataset.cifar.train10()())
+    assert ex[0].shape == (3, 32, 32)
+    ex = next(fluid.dataset.uci_housing.train()())
+    assert ex[0].shape == (13,) and ex[1].shape == (1,)
+
+
+def test_device_prefetcher_yields_device_arrays():
+    feeds = [{"x": np.ones((2, 2), "float32") * i} for i in range(5)]
+    got = list(R.DevicePrefetcher(iter(feeds), capacity=2))
+    assert len(got) == 5
+    import jax
+
+    assert isinstance(got[0]["x"], jax.Array)
+    np.testing.assert_array_equal(np.asarray(got[3]["x"]), feeds[3]["x"])
