@@ -1,0 +1,1 @@
+from .op_test import OpTest, check_grad, check_output, run_op  # noqa: F401

@@ -1,0 +1,113 @@
+"""Per-op numeric + grad checks: math / elementwise / reduce ops
+(mirrors reference tests: test_elementwise_add_op.py, test_matmul_op.py,
+test_reduce_op.py, ... via the OpTest harness)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.testing import check_grad, check_output, run_op
+
+
+@pytest.fixture
+def r():
+    return np.random.RandomState(0)
+
+
+def test_elementwise_add_broadcast_axis(r):
+    x = r.randn(2, 3, 4).astype("float32")
+    y = r.randn(3).astype("float32")
+    check_output("elementwise_add", {"X": x, "Y": y}, {"Out": x + y.reshape(1, 3, 1)},
+                 attrs={"axis": 1})
+    y2 = r.randn(4).astype("float32")
+    check_output("elementwise_add", {"X": x, "Y": y2}, {"Out": x + y2}, attrs={"axis": -1})
+
+
+def test_elementwise_family(r):
+    x = r.rand(3, 4).astype("float32") + 0.5
+    y = r.rand(3, 4).astype("float32") + 0.5
+    for op, fn in [("elementwise_add", np.add), ("elementwise_sub", np.subtract),
+                   ("elementwise_mul", np.multiply), ("elementwise_div", np.divide),
+                   ("elementwise_max", np.maximum), ("elementwise_min", np.minimum),
+                   ("elementwise_pow", np.power)]:
+        check_output(op, {"X": x, "Y": y}, {"Out": fn(x, y)}, atol=1e-5)
+    check_grad("elementwise_mul", {"X": x, "Y": y}, ["X", "Y"], "Out")
+    check_grad("elementwise_div", {"X": x, "Y": y}, ["X", "Y"], "Out", max_relative_error=1e-2)
+
+
+def test_matmul_and_mul(r):
+    x = r.randn(4, 5).astype("float32")
+    y = r.randn(5, 3).astype("float32")
+    check_output("matmul", {"X": x, "Y": y}, {"Out": x @ y}, atol=1e-4)
+    check_output("matmul", {"X": x.T, "Y": y}, {"Out": x @ y},
+                 attrs={"transpose_X": True}, atol=1e-4)
+    check_output("matmul", {"X": x, "Y": y}, {"Out": 2.5 * (x @ y)},
+                 attrs={"alpha": 2.5}, atol=1e-4)
+    check_grad("matmul", {"X": x, "Y": y}, ["X", "Y"], "Out", max_relative_error=1e-2)
+
+    x3 = r.randn(2, 3, 4).astype("float32")
+    w = r.randn(12, 6).astype("float32")
+    got = run_op("mul", {"X": x3, "Y": w}, ["Out"],
+                 attrs={"x_num_col_dims": 1, "y_num_col_dims": 1})["Out"]
+    np.testing.assert_allclose(np.asarray(got), x3.reshape(2, 12) @ w, atol=1e-4)
+
+
+def test_batched_matmul(r):
+    x = r.randn(3, 4, 5).astype("float32")
+    y = r.randn(3, 5, 6).astype("float32")
+    check_output("matmul", {"X": x, "Y": y}, {"Out": np.matmul(x, y)}, atol=1e-4)
+
+
+def test_scale_sum_mean_sign_clip(r):
+    x = r.randn(3, 4).astype("float32")
+    check_output("scale", {"X": x}, {"Out": 2 * x + 1}, attrs={"scale": 2.0, "bias": 1.0})
+    check_output("scale", {"X": x}, {"Out": 2 * (x + 1)},
+                 attrs={"scale": 2.0, "bias": 1.0, "bias_after_scale": False})
+    a, b = r.randn(3).astype("float32"), r.randn(3).astype("float32")
+    check_output("sum", {"X": [("a", a), ("b", b)]}, {"Out": a + b})
+    check_output("mean", {"X": x}, {"Out": np.mean(x)})
+    check_output("sign", {"X": x}, {"Out": np.sign(x)})
+    check_output("clip", {"X": x}, {"Out": np.clip(x, -0.5, 0.5)},
+                 attrs={"min": -0.5, "max": 0.5})
+    check_grad("mean", {"X": x}, ["X"], "Out")
+
+
+def test_clip_by_norm(r):
+    x = (r.randn(4, 4) * 10).astype("float32")
+    norm = np.sqrt((x ** 2).sum())
+    check_output("clip_by_norm", {"X": x}, {"Out": x * (1.0 / norm)},
+                 attrs={"max_norm": 1.0}, rtol=1e-4)
+    small = x * 0.001
+    check_output("clip_by_norm", {"X": small}, {"Out": small}, attrs={"max_norm": 1.0})
+
+
+def test_reduce_ops(r):
+    x = r.randn(2, 3, 4).astype("float32")
+    check_output("reduce_sum", {"X": x}, {"Out": x.sum(1)}, attrs={"dim": [1]}, atol=1e-5)
+    check_output("reduce_mean", {"X": x}, {"Out": x.mean((0, 2), keepdims=True)},
+                 attrs={"dim": [0, 2], "keep_dim": True}, atol=1e-5)
+    check_output("reduce_max", {"X": x}, {"Out": x.max()}, attrs={"reduce_all": True})
+    check_output("reduce_min", {"X": x}, {"Out": x.min(-1)}, attrs={"dim": [-1]})
+    check_output("reduce_prod", {"X": x}, {"Out": x.prod(2)}, attrs={"dim": [2]}, rtol=1e-4)
+    check_grad("reduce_sum", {"X": x}, ["X"], "Out", max_relative_error=1e-2)
+
+
+def test_cumsum_and_norm(r):
+    x = r.randn(3, 5).astype("float32")
+    check_output("cumsum", {"X": x}, {"Out": np.cumsum(x, 1)}, attrs={"axis": 1}, atol=1e-5)
+    rev = np.flip(np.cumsum(np.flip(x, 1), 1), 1)
+    check_output("cumsum", {"X": x}, {"Out": rev}, attrs={"axis": 1, "reverse": True}, atol=1e-5)
+    n = np.sqrt((x ** 2).sum(1, keepdims=True) + 1e-10)
+    check_output("norm", {"X": x}, {"Out": x / n, "Norm": n}, attrs={"axis": 1}, atol=1e-5)
+    check_output("squared_l2_norm", {"X": x}, {"Out": (x ** 2).sum()}, rtol=1e-5)
+    check_output("l1_norm", {"X": x}, {"Out": np.abs(x).sum()}, rtol=1e-5)
+
+
+def test_cast_increment_isfinite(r):
+    x = r.randn(3).astype("float32")
+    got = run_op("cast", {"X": x}, ["Out"], attrs={"out_dtype": "int32"})["Out"]
+    np.testing.assert_array_equal(np.asarray(got), x.astype("int32"))
+    check_output("increment", {"X": np.array([3.0], "float32")},
+                 {"Out": np.array([5.0], "float32")}, attrs={"step": 2.0})
+    assert bool(run_op("isfinite", {"X": np.array([1.0, np.inf])}, ["Out"])["Out"]) is False
+    assert bool(run_op("has_nan", {"X": np.array([1.0, np.nan])}, ["Out"])["Out"]) is True
+    assert bool(run_op("has_inf", {"X": np.array([1.0, np.nan])}, ["Out"])["Out"]) is False

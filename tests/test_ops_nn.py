@@ -1,0 +1,231 @@
+"""Per-op checks: activations, softmax/losses, normalization, conv/pool,
+embedding, attention (mirrors test_activation_op.py, test_softmax_op.py,
+test_batch_norm_op.py, test_conv2d_op.py, test_pool2d_op.py,
+test_lookup_table_op.py)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.testing import check_grad, check_output, run_op
+
+
+@pytest.fixture
+def r():
+    return np.random.RandomState(1)
+
+
+def test_activations_numeric(r):
+    x = (r.randn(3, 4) * 2).astype("float32")
+    cases = {
+        "sigmoid": 1 / (1 + np.exp(-x)),
+        "relu": np.maximum(x, 0),
+        "tanh": np.tanh(x),
+        "exp": np.exp(x),
+        "square": x * x,
+        "abs": np.abs(x),
+        "softsign": x / (1 + np.abs(x)),
+        "reciprocal": 1 / x,
+        "leaky_relu": np.where(x >= 0, x, 0.02 * x),
+    }
+    for op, want in cases.items():
+        attrs = {"alpha": 0.02} if op == "leaky_relu" else {}
+        check_output(op, {"X": x}, {"Out": want.astype("float32")}, attrs=attrs,
+                     atol=1e-5, rtol=1e-4)
+    xp = np.abs(x) + 0.1
+    check_output("sqrt", {"X": xp}, {"Out": np.sqrt(xp)}, atol=1e-5)
+    check_output("log", {"X": xp}, {"Out": np.log(xp)}, atol=1e-5)
+
+
+def test_activation_grads(r):
+    x = (r.randn(2, 3) + 0.1).astype("float32")
+    for op in ("sigmoid", "tanh", "softplus", "swish", "gelu"):
+        check_grad(op, {"X": x}, ["X"], "Out", max_relative_error=2e-2)
+
+
+def test_softmax_and_cross_entropy(r):
+    x = r.randn(4, 7).astype("float32")
+    e = np.exp(x - x.max(-1, keepdims=True))
+    sm = e / e.sum(-1, keepdims=True)
+    check_output("softmax", {"X": x}, {"Out": sm}, atol=1e-5)
+
+    label = r.randint(0, 7, (4, 1)).astype("int64")
+    want_loss = -np.log(sm[np.arange(4), label.ravel()]).reshape(4, 1)
+    check_output("softmax_with_cross_entropy", {"Logits": x, "Label": label},
+                 {"Loss": want_loss.astype("float32"), "Softmax": sm}, atol=1e-5)
+    check_output("cross_entropy", {"X": sm.astype("float32"), "Label": label},
+                 {"Y": want_loss.astype("float32")}, atol=1e-5)
+    # soft labels
+    soft = np.abs(r.rand(4, 7)).astype("float32")
+    soft /= soft.sum(-1, keepdims=True)
+    want_soft = -(soft * np.log(sm)).sum(-1, keepdims=True)
+    check_output("softmax_with_cross_entropy", {"Logits": x, "Label": soft},
+                 {"Loss": want_soft.astype("float32")}, attrs={"soft_label": True},
+                 atol=1e-5)
+    check_grad("softmax_with_cross_entropy", {"Logits": x, "Label": label},
+               ["Logits"], "Loss", max_relative_error=1e-2)
+
+
+def test_sigmoid_xent_and_losses(r):
+    x = r.randn(4, 3).astype("float32")
+    lbl = r.randint(0, 2, (4, 3)).astype("float32")
+    want = np.maximum(x, 0) - x * lbl + np.log1p(np.exp(-np.abs(x)))
+    check_output("sigmoid_cross_entropy_with_logits", {"X": x, "Label": lbl},
+                 {"Out": want}, atol=1e-5)
+    p = np.clip(r.rand(4, 1).astype("float32"), 0.1, 0.9)
+    y = r.randint(0, 2, (4, 1)).astype("float32")
+    eps = 1e-4
+    check_output("log_loss", {"Predicted": p, "Labels": y},
+                 {"Loss": (-y * np.log(p + eps) - (1 - y) * np.log(1 - p + eps))},
+                 atol=1e-5)
+    check_output("huber_loss", {"X": x[:, :1], "Y": x[:, 1:2]},
+                 {"Out": np.where(np.abs(x[:, 1:2] - x[:, :1]) <= 1.0,
+                                  0.5 * (x[:, 1:2] - x[:, :1]) ** 2,
+                                  np.abs(x[:, 1:2] - x[:, :1]) - 0.5)},
+                 attrs={"delta": 1.0}, atol=1e-5)
+
+
+def test_layer_norm_numeric(r):
+    x = r.randn(4, 6).astype("float32")
+    scale = r.rand(6).astype("float32")
+    bias = r.rand(6).astype("float32")
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    want = (x - mean) / np.sqrt(var + 1e-5) * scale + bias
+    check_output("layer_norm", {"X": x, "Scale": scale, "Bias": bias},
+                 {"Y": want, "Mean": mean.ravel(), "Variance": var.ravel()},
+                 attrs={"begin_norm_axis": 1}, atol=1e-4)
+    check_grad("layer_norm", {"X": x, "Scale": scale, "Bias": bias},
+               ["X", "Scale"], "Y", max_relative_error=2e-2)
+
+
+def test_batch_norm_train_and_infer(r):
+    x = r.randn(4, 3, 2, 2).astype("float32")
+    scale = np.ones(3, "float32")
+    bias = np.zeros(3, "float32")
+    mean = np.zeros(3, "float32")
+    var = np.ones(3, "float32")
+    bmean = x.mean((0, 2, 3))
+    bvar = x.var((0, 2, 3))
+    want = (x - bmean.reshape(1, 3, 1, 1)) / np.sqrt(bvar.reshape(1, 3, 1, 1) + 1e-5)
+    out = run_op("batch_norm",
+                 {"X": x, "Scale": scale, "Bias": bias, "Mean": mean, "Variance": var},
+                 ["Y", "MeanOut", "VarianceOut"],
+                 attrs={"momentum": 0.9, "epsilon": 1e-5})
+    np.testing.assert_allclose(np.asarray(out["Y"]), want, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(out["MeanOut"]), 0.9 * mean + 0.1 * bmean, atol=1e-5)
+    # inference path uses running stats
+    out_t = run_op("batch_norm",
+                   {"X": x, "Scale": scale, "Bias": bias, "Mean": bmean, "Variance": bvar},
+                   ["Y"], attrs={"is_test": True, "epsilon": 1e-5}, is_test=True)
+    np.testing.assert_allclose(np.asarray(out_t["Y"]), want, atol=1e-4)
+
+
+def test_conv2d_numeric_small(r):
+    # hand-check a 1-channel 3x3 conv against explicit correlation
+    x = r.randn(1, 1, 4, 4).astype("float32")
+    w = r.randn(1, 1, 3, 3).astype("float32")
+    want = np.zeros((1, 1, 2, 2), "float32")
+    for i in range(2):
+        for j in range(2):
+            want[0, 0, i, j] = (x[0, 0, i:i+3, j:j+3] * w[0, 0]).sum()
+    check_output("conv2d", {"Input": x, "Filter": w}, {"Output": want},
+                 attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+                        "groups": 1}, atol=1e-4)
+    check_grad("conv2d", {"Input": x, "Filter": w}, ["Input", "Filter"], "Output",
+               attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+                      "groups": 1}, max_relative_error=2e-2)
+
+
+def test_depthwise_and_grouped_conv(r):
+    x = r.randn(2, 4, 5, 5).astype("float32")
+    w = r.randn(4, 1, 3, 3).astype("float32")  # groups=4 depthwise
+    out = run_op("depthwise_conv2d", {"Input": x, "Filter": w}, ["Output"],
+                 attrs={"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+                        "groups": 4})["Output"]
+    assert np.asarray(out).shape == (2, 4, 5, 5)
+    # each output channel depends only on its input channel
+    x2 = x.copy(); x2[:, 0] += 100.0
+    out2 = run_op("depthwise_conv2d", {"Input": x2, "Filter": w}, ["Output"],
+                  attrs={"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+                         "groups": 4})["Output"]
+    diff = np.abs(np.asarray(out2) - np.asarray(out))
+    assert diff[:, 0].max() > 1 and diff[:, 1:].max() < 1e-3
+
+
+def test_pool2d_numeric(r):
+    x = r.randn(1, 1, 4, 4).astype("float32")
+    want_max = x.reshape(1, 1, 2, 2, 2, 2).max((3, 5))
+    check_output("pool2d", {"X": x}, {"Out": want_max},
+                 attrs={"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2],
+                        "paddings": [0, 0]})
+    want_avg = x.reshape(1, 1, 2, 2, 2, 2).mean((3, 5))
+    check_output("pool2d", {"X": x}, {"Out": want_avg},
+                 attrs={"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2],
+                        "paddings": [0, 0]}, atol=1e-5)
+    check_output("pool2d", {"X": x}, {"Out": x.max((2, 3), keepdims=True)},
+                 attrs={"pooling_type": "max", "global_pooling": True, "ksize": [1, 1],
+                        "strides": [1, 1], "paddings": [0, 0]})
+
+
+def test_lookup_table(r):
+    w = r.randn(10, 4).astype("float32")
+    ids = np.array([[1], [3], [0]], dtype="int64")
+    check_output("lookup_table", {"W": w, "Ids": ids}, {"Out": w[[1, 3, 0]]})
+    # padding_idx zeroes that row
+    out = run_op("lookup_table", {"W": w, "Ids": ids}, ["Out"],
+                 attrs={"padding_idx": 3})["Out"]
+    got = np.asarray(out)
+    assert np.allclose(got[1], 0) and np.allclose(got[0], w[1])
+    check_grad("lookup_table", {"W": w, "Ids": ids}, ["W"], "Out",
+               max_relative_error=1e-2)
+
+
+def test_dropout_modes(r):
+    x = np.ones((64, 64), "float32")
+    out = np.asarray(run_op("dropout", {"X": x}, ["Out"],
+                            attrs={"dropout_prob": 0.3, "seed": 5})["Out"])
+    keep = (out != 0).mean()
+    assert 0.6 < keep < 0.8
+    assert set(np.unique(out)).issubset({0.0, 1.0})
+    up = np.asarray(run_op("dropout", {"X": x}, ["Out"],
+                           attrs={"dropout_prob": 0.3, "seed": 5,
+                                  "dropout_implementation": "upscale_in_train"})["Out"])
+    nz = np.unique(up[up != 0])
+    np.testing.assert_allclose(nz, np.full_like(nz, 1 / 0.7), rtol=1e-5)
+    # inference: downgrade scales by (1-p); upscale passes through
+    inf = np.asarray(run_op("dropout", {"X": x}, ["Out"],
+                            attrs={"dropout_prob": 0.3, "is_test": True}, is_test=True)["Out"])
+    np.testing.assert_allclose(inf, x * 0.7, rtol=1e-6)
+
+
+def test_attention_matches_reference_composition(r):
+    b, h, s, d = 2, 2, 8, 4
+    q = r.randn(b, h, s, d).astype("float32")
+    k = r.randn(b, h, s, d).astype("float32")
+    v = r.randn(b, h, s, d).astype("float32")
+    scores = np.einsum("bhqd,bhkd->bhqk", q, k) * (d ** -0.5)
+    e = np.exp(scores - scores.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bhkd->bhqd", p, v)
+    check_output("scaled_dot_product_attention", {"Q": q, "K": k, "V": v},
+                 {"Out": want}, attrs={"sm_scale": d ** -0.5}, atol=1e-4)
+    # causal: position 0 attends only to itself
+    causal = np.asarray(run_op("scaled_dot_product_attention",
+                               {"Q": q, "K": k, "V": v}, ["Out"],
+                               attrs={"causal": True, "sm_scale": d ** -0.5})["Out"])
+    np.testing.assert_allclose(causal[:, :, 0], v[:, :, 0], atol=1e-4)
+    check_grad("scaled_dot_product_attention", {"Q": q, "K": k, "V": v},
+               ["Q", "K", "V"], "Out", attrs={"sm_scale": d ** -0.5},
+               max_relative_error=2e-2)
+
+
+def test_one_hot_topk_argsort(r):
+    ids = np.array([[1], [0], [3]], dtype="int64")
+    want = np.zeros((3, 4), "float32")
+    want[[0, 1, 2], [1, 0, 3]] = 1
+    check_output("one_hot", {"X": ids}, {"Out": want}, attrs={"depth": 4})
+    x = r.randn(3, 5).astype("float32")
+    got = run_op("top_k", {"X": x}, ["Out", "Indices"], attrs={"k": 2})
+    np.testing.assert_allclose(np.asarray(got["Out"]), np.sort(x, -1)[:, ::-1][:, :2], atol=1e-6)
+    got = run_op("argsort", {"X": x}, ["Out", "Indices"], attrs={"axis": -1})
+    np.testing.assert_allclose(np.asarray(got["Out"]), np.sort(x, -1), atol=1e-6)

@@ -1,0 +1,155 @@
+"""Per-op checks: tensor manipulation, fill/random, optimizer update ops
+(mirrors test_reshape_op.py, test_concat_op.py, test_sgd_op.py,
+test_adam_op.py, ...)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.testing import check_output, run_op
+
+
+@pytest.fixture
+def r():
+    return np.random.RandomState(2)
+
+
+def test_reshape_family(r):
+    x = r.randn(2, 3, 4).astype("float32")
+    check_output("reshape", {"X": x}, {"Out": x.reshape(6, 4)}, attrs={"shape": [6, 4]})
+    check_output("reshape2", {"X": x}, {"Out": x.reshape(2, 12)}, attrs={"shape": [0, -1]})
+    check_output("flatten", {"X": x}, {"Out": x.reshape(2, 12)}, attrs={"axis": 1})
+    check_output("squeeze", {"X": x[:, :1]}, {"Out": x[:, 0]}, attrs={"axes": [1]})
+    check_output("unsqueeze", {"X": x}, {"Out": x[:, None]}, attrs={"axes": [1]})
+    check_output("transpose", {"X": x}, {"Out": x.transpose(2, 0, 1)},
+                 attrs={"axis": [2, 0, 1]})
+
+
+def test_concat_split_stack(r):
+    a = r.randn(2, 3).astype("float32")
+    b = r.randn(2, 5).astype("float32")
+    check_output("concat", {"X": [("a", a), ("b", b)]},
+                 {"Out": np.concatenate([a, b], 1)}, attrs={"axis": 1})
+    x = r.randn(2, 6).astype("float32")
+    got = run_op("split", {"X": x}, ["Out"], attrs={"num": 3, "axis": 1})
+    # split writes multiple outputs under one slot; run_op returns the first
+    s = run_op("split", {"X": x}, ["Out"], attrs={"sections": [2, 4], "axis": 1})
+    np.testing.assert_allclose(np.asarray(s["Out"]), x[:, :2])
+    c, d = r.randn(3).astype("float32"), r.randn(3).astype("float32")
+    check_output("stack", {"X": [("c", c), ("d", d)]},
+                 {"Y": np.stack([c, d])}, attrs={"axis": 0})
+
+
+def test_slice_gather_scatter_pad(r):
+    x = r.randn(4, 5).astype("float32")
+    check_output("slice", {"Input": x}, {"Out": x[1:3, :2]},
+                 attrs={"axes": [0, 1], "starts": [1, 0], "ends": [3, 2]})
+    check_output("slice", {"Input": x}, {"Out": x[:, -2:]},
+                 attrs={"axes": [1], "starts": [-2], "ends": [5]})
+    idx = np.array([2, 0], dtype="int64")
+    check_output("gather", {"X": x, "Index": idx}, {"Out": x[[2, 0]]})
+    upd = r.randn(2, 5).astype("float32")
+    want = x.copy(); want[[1, 3]] = upd
+    check_output("scatter", {"X": x, "Ids": np.array([1, 3], "int64"), "Updates": upd},
+                 {"Out": want})
+    want_add = x.copy(); want_add[[1, 3]] += upd
+    check_output("scatter", {"X": x, "Ids": np.array([1, 3], "int64"), "Updates": upd},
+                 {"Out": want_add}, attrs={"overwrite": False}, atol=1e-5)
+    check_output("pad", {"X": x}, {"Out": np.pad(x, [(1, 0), (0, 2)], constant_values=9.0)},
+                 attrs={"paddings": [1, 0, 0, 2], "pad_value": 9.0})
+    check_output("expand", {"X": x}, {"Out": np.tile(x, (2, 1))},
+                 attrs={"expand_times": [2, 1]})
+
+
+def test_fill_and_random_ops(r):
+    check_output("fill_constant", {}, {"Out": np.full((2, 3), 7.0, "float32")},
+                 attrs={"shape": [2, 3], "dtype": "float32", "value": 7.0})
+    x = r.randn(5, 2).astype("float32")
+    check_output("fill_zeros_like", {"X": x}, {"Out": np.zeros_like(x)})
+    check_output("fill_constant_batch_size_like", {"Input": x},
+                 {"Out": np.ones((5, 4), "float32")},
+                 attrs={"shape": [1, 4], "dtype": "float32", "value": 1.0})
+    u = np.asarray(run_op("uniform_random", {}, ["Out"],
+                          attrs={"shape": [1000], "min": -2.0, "max": 2.0, "seed": 1})["Out"])
+    assert -2.0 <= u.min() and u.max() <= 2.0 and abs(u.mean()) < 0.2
+    g = np.asarray(run_op("gaussian_random", {}, ["Out"],
+                          attrs={"shape": [2000], "mean": 1.0, "std": 2.0, "seed": 1})["Out"])
+    assert abs(g.mean() - 1.0) < 0.2 and abs(g.std() - 2.0) < 0.2
+    # determinism: same seed → same draw
+    u2 = np.asarray(run_op("uniform_random", {}, ["Out"],
+                           attrs={"shape": [1000], "min": -2.0, "max": 2.0, "seed": 1})["Out"])
+    np.testing.assert_array_equal(u, u2)
+
+
+def test_sgd_momentum_adam_updates(r):
+    p = r.randn(4).astype("float32")
+    g = r.randn(4).astype("float32")
+    lr = np.array([0.1], "float32")
+    check_output("sgd", {"Param": p, "Grad": g, "LearningRate": lr},
+                 {"ParamOut": p - 0.1 * g}, atol=1e-6)
+
+    v = r.randn(4).astype("float32")
+    v_new = 0.9 * v + g
+    check_output("momentum",
+                 {"Param": p, "Grad": g, "Velocity": v, "LearningRate": lr},
+                 {"ParamOut": p - 0.1 * v_new, "VelocityOut": v_new},
+                 attrs={"mu": 0.9}, atol=1e-6)
+
+    m = np.zeros(4, "float32"); vv = np.zeros(4, "float32")
+    b1p = np.array([0.9], "float32"); b2p = np.array([0.999], "float32")
+    m_new = 0.1 * g
+    v_new2 = 0.001 * g * g
+    lr_t = 0.1 * np.sqrt(1 - 0.999) / (1 - 0.9)
+    want_p = p - lr_t * m_new / (np.sqrt(v_new2) + 1e-8)
+    out = run_op("adam", {"Param": p, "Grad": g, "Moment1": m, "Moment2": vv,
+                          "Beta1Pow": b1p, "Beta2Pow": b2p, "LearningRate": lr},
+                 ["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut"],
+                 attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8})
+    np.testing.assert_allclose(np.asarray(out["ParamOut"]), want_p, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["Beta1PowOut"]), [0.81], rtol=1e-5)
+
+
+def test_rmsprop_adagrad_updates(r):
+    p = r.randn(3).astype("float32")
+    g = r.randn(3).astype("float32")
+    lr = np.array([0.01], "float32")
+    moment = np.abs(r.randn(3)).astype("float32")
+    m_new = moment + g * g
+    check_output("adagrad", {"Param": p, "Grad": g, "Moment": moment, "LearningRate": lr},
+                 {"ParamOut": p - 0.01 * g / (np.sqrt(m_new) + 1e-6), "MomentOut": m_new},
+                 attrs={"epsilon": 1e-6}, atol=1e-5)
+    ms = np.abs(r.randn(3)).astype("float32")
+    mom = np.zeros(3, "float32")
+    ms_new = 0.9 * ms + 0.1 * g * g
+    mom_new = 0.01 * g / np.sqrt(ms_new + 1e-10)
+    check_output("rmsprop", {"Param": p, "Grad": g, "MeanSquare": ms, "Moment": mom,
+                             "LearningRate": lr},
+                 {"ParamOut": p - mom_new, "MeanSquareOut": ms_new},
+                 attrs={"decay": 0.9, "epsilon": 1e-10, "momentum": 0.0}, atol=1e-5)
+
+
+def test_compare_and_logical(r):
+    x = np.array([1.0, 2.0, 3.0], "float32")
+    y = np.array([2.0, 2.0, 2.0], "float32")
+    check_output("less_than", {"X": x, "Y": y}, {"Out": x < y})
+    check_output("equal", {"X": x, "Y": y}, {"Out": x == y})
+    check_output("greater_equal", {"X": x, "Y": y}, {"Out": x >= y})
+    a = np.array([True, False, True])
+    b = np.array([True, True, False])
+    check_output("logical_and", {"X": a, "Y": b}, {"Out": a & b})
+    check_output("logical_not", {"X": a}, {"Out": ~a})
+
+
+def test_where_label_smooth_interp(r):
+    c = np.array([True, False])
+    x = np.array([1.0, 2.0], "float32")
+    y = np.array([9.0, 8.0], "float32")
+    check_output("where", {"Condition": c, "X": x, "Y": y},
+                 {"Out": np.where(c, x, y)})
+    oh = np.eye(4, dtype="float32")[[0, 2]]
+    want = 0.9 * oh + 0.1 / 4
+    check_output("label_smooth", {"X": oh}, {"Out": want}, attrs={"epsilon": 0.1},
+                 atol=1e-6)
+    img = r.randn(1, 1, 2, 2).astype("float32")
+    out = np.asarray(run_op("nearest_interp", {"X": img}, ["Out"],
+                            attrs={"out_h": 4, "out_w": 4})["Out"])
+    assert out.shape == (1, 1, 4, 4)
