@@ -1,0 +1,26 @@
+"""Block interpreter: runs a list of symbolic ops over a name→array env.
+
+This is Fluid's executor hot loop (``framework/executor.cc:433``) — but it
+executes exactly once per compilation, inside ``jax.jit`` tracing, so the
+per-step cost is zero. Shared by the Executor and by control-flow ops
+(while/cond/recurrent), which recursively interpret sub-blocks inside
+``lax.while_loop``/``lax.cond``/``lax.scan`` bodies.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from .registry import OpContext, get_op_impl
+
+# Ops that are markers/IO and never execute as kernels.
+SKIP_OPS = frozenset({"backward_marker", "feed", "fetch"})
+
+
+def run_block_ops(ops, env: Dict[str, Any], trace, offset: int = 0):
+    for i, op in enumerate(ops):
+        if op.type in SKIP_OPS:
+            continue
+        trace.current_op_idx = offset + i
+        impl = get_op_impl(op.type)
+        impl(OpContext(op, env, trace))

@@ -26,14 +26,12 @@ import numpy as np
 from . import ops as _ops  # noqa: F401 — registers all op impls
 from .core.dtypes import to_jnp_dtype
 from .core.framework import Program, Variable, default_main_program, grad_var_name
+from .core.interpreter import run_block_ops
 from .core.place import Place, get_device
 from .core.registry import OpContext, get_op_impl
 from .core.scope import Scope, global_scope
 
 __all__ = ["Executor", "TraceContext"]
-
-# Ops that are markers/IO and never execute as kernels.
-_SKIP_OPS = frozenset({"backward_marker", "feed", "fetch"})
 
 
 class TraceContext:
@@ -53,16 +51,6 @@ class TraceContext:
         else:
             key = self.base_rng
         return jax.random.fold_in(key, self.current_op_idx)
-
-
-def run_block_ops(ops, env: Dict[str, Any], trace: TraceContext, offset: int = 0):
-    """The Fluid hot loop (executor.cc:433) — but executed once, under trace."""
-    for i, op in enumerate(ops):
-        if op.type in _SKIP_OPS:
-            continue
-        trace.current_op_idx = offset + i
-        impl = get_op_impl(op.type)
-        impl(OpContext(op, env, trace))
 
 
 def _canon(value, dtype_name: str):

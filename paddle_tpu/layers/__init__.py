@@ -1,4 +1,20 @@
-from . import io, nn, tensor  # noqa: F401
+from . import attention, control_flow, io, learning_rate_scheduler, nn, sequence, tensor  # noqa: F401
+from .attention import multi_head_attention, scaled_dot_product_attention  # noqa: F401
+from .control_flow import (  # noqa: F401
+    StaticRNN,
+    While,
+    cond,
+    equal,
+    greater_equal,
+    greater_than,
+    less_equal,
+    less_than,
+    logical_and,
+    logical_not,
+    logical_or,
+    not_equal,
+)
+from .sequence import *  # noqa: F401,F403
 from .io import data  # noqa: F401
 from .layer_helper import LayerHelper, ParamAttr  # noqa: F401
 from .nn import *  # noqa: F401,F403

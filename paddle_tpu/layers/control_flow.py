@@ -1,0 +1,292 @@
+"""Control-flow layers (reference: python/paddle/fluid/layers/control_flow.py —
+While :504, StaticRNN :278, less_than/equal helpers, increment).
+
+Sub-blocks hold the body ops, exactly Fluid's representation; execution lowers
+to lax.while_loop / lax.cond / lax.scan (see ops/control_flow_ops.py).
+DynamicRNN's LoD-bucketed batching has no XLA analog — use StaticRNN over
+padded [T, B, ...] tensors with masks (see sequence ops), the idiomatic
+replacement.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..core import unique_name
+from ..core.framework import Variable, default_main_program
+from .layer_helper import LayerHelper
+from . import tensor as tl
+
+__all__ = ["While", "cond", "StaticRNN", "less_than", "less_equal",
+           "greater_than", "greater_equal", "equal", "not_equal",
+           "logical_and", "logical_or", "logical_not", "increment"]
+
+
+def _cmp_layer(op_type, x, y, cond=None):
+    helper = LayerHelper(op_type)
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool")
+    helper.append_op(op_type, inputs={"X": x, "Y": y}, outputs={"Out": cond})
+    return cond
+
+
+def less_than(x, y, cond=None, force_cpu=None):
+    return _cmp_layer("less_than", x, y, cond)
+
+
+def less_equal(x, y, cond=None):
+    return _cmp_layer("less_equal", x, y, cond)
+
+
+def greater_than(x, y, cond=None):
+    return _cmp_layer("greater_than", x, y, cond)
+
+
+def greater_equal(x, y, cond=None):
+    return _cmp_layer("greater_equal", x, y, cond)
+
+
+def equal(x, y, cond=None):
+    return _cmp_layer("equal", x, y, cond)
+
+
+def not_equal(x, y, cond=None):
+    return _cmp_layer("not_equal", x, y, cond)
+
+
+def logical_and(x, y, out=None):
+    return _cmp_layer("logical_and", x, y, out)
+
+
+def logical_or(x, y, out=None):
+    return _cmp_layer("logical_or", x, y, out)
+
+
+def logical_not(x, out=None):
+    helper = LayerHelper("logical_not")
+    if out is None:
+        out = helper.create_variable_for_type_inference("bool")
+    helper.append_op("logical_not", inputs={"X": x}, outputs={"Out": out})
+    return out
+
+
+increment = tl.increment
+
+
+class While:
+    """Fluid-style while loop (reference: control_flow.py:504).
+
+        i = fluid.layers.fill_constant([1], 'int64', 0)
+        n = fluid.layers.fill_constant([1], 'int64', 10)
+        s = fluid.layers.fill_constant([1], 'float32', 0.0)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with w.block():
+            layers.assign(s + 1.0, s)
+            layers.increment(i, in_place=True)
+            fluid.layers.less_than(i, n, cond=cond)  # update condition
+
+    Loop-carried vars are detected automatically: any pre-existing var
+    re-assigned inside the body (Fluid's scope-mutation contract, made
+    functional as the lax.while_loop carry).
+    """
+
+    def __init__(self, cond: Variable, is_test: bool = False, name: Optional[str] = None):
+        if cond.dtype != "bool":
+            raise TypeError("While condition must be a bool Variable")
+        self.cond_var = cond
+        self.helper = LayerHelper("while", name=name)
+
+    @contextlib.contextmanager
+    def block(self):
+        program = default_main_program()
+        parent_block = program.current_block()
+        sub = program._create_block()
+        try:
+            yield
+        finally:
+            program._rollback()
+        # carry set: vars written in the body that already existed outside
+        carry = []
+        for op in sub.ops:
+            for name in op.output_arg_names:
+                if name not in sub.vars and name not in carry:
+                    carry.append(name)
+        if self.cond_var.name not in carry:
+            raise ValueError(
+                "While body never updates the condition %r — infinite loop"
+                % self.cond_var.name)
+        parent_block.append_op(
+            "while",
+            inputs={"Condition": self.cond_var},
+            outputs={"Out": carry},
+            attrs={"sub_block": sub.idx, "carry_vars": carry},
+        )
+
+
+def cond(pred: Variable, true_fn: Callable, false_fn: Optional[Callable] = None):
+    """Functional two-branch conditional lowering to lax.cond.
+
+    Returns the true_fn/false_fn result (a Variable or tuple of Variables;
+    both branches must return matching shapes/dtypes — XLA requirement).
+    """
+    program = default_main_program()
+    parent_block = program.current_block()
+    helper = LayerHelper("cond")
+
+    def build(fn):
+        blk = program._create_block()
+        try:
+            res = fn()
+        finally:
+            program._rollback()
+        if res is None:
+            res = ()
+        res_t = res if isinstance(res, (list, tuple)) else (res,)
+        return blk, tuple(res_t), not isinstance(res, (list, tuple))
+
+    true_blk, true_outs, single = build(true_fn)
+    if false_fn is None:
+        raise ValueError("cond requires false_fn returning the same structure "
+                         "(XLA needs both branches)")
+    false_blk, false_outs, _ = build(false_fn)
+    if len(true_outs) != len(false_outs):
+        raise ValueError("cond branches return different arities: %d vs %d"
+                         % (len(true_outs), len(false_outs)))
+
+    out_vars = []
+    for tv, fv in zip(true_outs, false_outs):
+        out = parent_block.create_var(
+            name=unique_name.generate("cond_out"), dtype=tv.dtype, shape=tv.shape)
+        # bind each branch's result to the shared output name
+        true_blk.append_op("assign", inputs={"X": tv}, outputs={"Out": out})
+        false_blk.append_op("assign", inputs={"X": fv}, outputs={"Out": out})
+        out_vars.append(out)
+
+    parent_block.append_op(
+        "conditional_block",
+        inputs={"Cond": pred},
+        outputs={"Out": [v.name for v in out_vars]},
+        attrs={"true_block": true_blk.idx, "false_block": false_blk.idx},
+    )
+    if single and out_vars:
+        return out_vars[0]
+    return tuple(out_vars)
+
+
+class StaticRNN:
+    """Static (unrolled-shape) RNN over time-major inputs
+    (reference: control_flow.py:278), lowering to lax.scan — differentiable.
+
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            w = rnn.step_input(x_tm)           # x_tm: [T, B, D]
+            prev = rnn.memory(init=h0)         # h0:   [B, H]
+            h = fluid.layers.fc([w, prev], size=H, act='tanh')
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        outs = rnn()                           # [T, B, H]
+    """
+
+    def __init__(self, name: Optional[str] = None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self._sub_block = None
+        self._parent_block = None
+        self._step_inputs: List[Tuple[str, str]] = []
+        self._memories: List[Tuple[str, str, str]] = []
+        self._mem_updates = {}
+        self._step_outputs: List[Variable] = []
+        self._outputs: List[Variable] = []
+        self._final_states: List[Variable] = []
+        self._seq_len = None
+
+    @contextlib.contextmanager
+    def step(self):
+        program = default_main_program()
+        self._parent_block = program.current_block()
+        self._sub_block = program._create_block()
+        try:
+            yield
+        finally:
+            program._rollback()
+            self._complete()
+
+    def step_input(self, x: Variable) -> Variable:
+        if x.shape is None or len(x.shape) < 1:
+            raise ValueError("step_input needs a [T, ...] shaped Variable")
+        if self._seq_len is None:
+            self._seq_len = x.shape[0]
+        inner = self._sub_block.create_var(
+            name=unique_name.generate("rnn_step_in"),
+            dtype=x.dtype, shape=x.shape[1:])
+        self._step_inputs.append((x.name, inner.name))
+        return inner
+
+    def memory(self, init: Optional[Variable] = None, shape=None, value=0.0,
+               batch_ref: Optional[Variable] = None, dtype="float32") -> Variable:
+        if init is None:
+            if batch_ref is None or shape is None:
+                raise ValueError("memory needs init=Variable, or shape+batch_ref")
+            init = tl.fill_constant_batch_size_like(
+                batch_ref, [d if d != -1 else 1 for d in shape], dtype, value)
+        prev = self._sub_block.create_var(
+            name=unique_name.generate("rnn_mem_prev"),
+            dtype=init.dtype, shape=init.shape)
+        self._memories.append([prev.name, None, init.name])
+        return prev
+
+    def update_memory(self, prev: Variable, new: Variable):
+        for m in self._memories:
+            if m[0] == prev.name:
+                m[1] = new.name
+                return
+        raise ValueError("update_memory: %r is not a memory of this RNN" % prev.name)
+
+    def step_output(self, o: Variable):
+        self._step_outputs.append(o)
+
+    output = step_output
+
+    def _complete(self):
+        for m in self._memories:
+            if m[1] is None:
+                raise ValueError("memory %r was never update_memory'd" % m[0])
+        outer_outs = []
+        for o in self._step_outputs:
+            shape = (self._seq_len,) + tuple(o.shape or ())
+            outer = self._parent_block.create_var(
+                name=unique_name.generate("rnn_out"), dtype=o.dtype, shape=shape)
+            outer_outs.append(outer)
+        finals = []
+        for prev_name, _, init_name in self._memories:
+            init_var = self._parent_block.var(init_name)
+            fs = self._parent_block.create_var(
+                name=unique_name.generate("rnn_final"), dtype=init_var.dtype,
+                shape=init_var.shape)
+            finals.append(fs)
+        self._outputs = outer_outs
+        self._final_states = finals
+        self._parent_block.append_op(
+            "recurrent",
+            inputs={
+                "X": [outer for outer, _ in self._step_inputs],
+                "Boot": [init for _, _, init in self._memories],
+            },
+            outputs={"Out": outer_outs, "FinalStates": finals},
+            attrs={
+                "sub_block": self._sub_block.idx,
+                "step_inputs": [list(p) for p in self._step_inputs],
+                "memories": [list(m) for m in self._memories],
+                "step_outputs": [o.name for o in self._step_outputs],
+            },
+        )
+
+    def __call__(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0]
+        return tuple(self._outputs)
+
+    @property
+    def final_states(self):
+        return self._final_states

@@ -4,9 +4,11 @@ from . import (  # noqa: F401
     activation_ops,
     attention_ops,
     compare_ops,
+    control_flow_ops,
     math_ops,
     nn_ops,
     optimizer_ops,
     reduce_ops,
+    sequence_ops,
     tensor_ops,
 )

@@ -1,0 +1,116 @@
+"""Control-flow ops: while / cond / recurrent (StaticRNN).
+
+Fluid runs sub-blocks through the C++ executor recursively
+(``operators/controlflow/while_op.cc``, ``conditional_block_op.cc``,
+``recurrent_op.cc``) with scope inheritance. The TPU-native equivalents are
+XLA-structured control flow — ``lax.while_loop``, ``lax.cond``, ``lax.scan``
+— with the sub-block interpreted inside the body and the written-variable set
+threaded as the functional carry (replacing Fluid's kid-scope mutation,
+executor.cc:447-456).
+
+Notes:
+- ``recurrent`` (StaticRNN) uses lax.scan and is fully differentiable — the
+  training path for RNNs.
+- ``while`` uses lax.while_loop: forward-only under autodiff (XLA's reverse
+  rule limitation); use recurrent/scan for trainable loops, while for
+  inference-style loops (beam search, generation).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.interpreter import run_block_ops
+from ..core.registry import OpContext, register_op
+
+
+def _sub_block(ctx: OpContext, attr_name: str):
+    return ctx.trace.program.blocks[ctx.attr(attr_name)]
+
+
+@register_op("while")
+def while_op(ctx: OpContext):
+    block = _sub_block(ctx, "sub_block")
+    cond_name = ctx.op.inputs["Condition"][0]
+    carry_names = list(ctx.attr("carry_vars"))
+    env = ctx.env
+
+    def cond_fn(carry):
+        return carry[cond_name].reshape(())
+
+    def body_fn(carry):
+        local = dict(env)
+        local.update(carry)
+        run_block_ops(block.ops, local, ctx.trace, offset=10_000 * block.idx)
+        return {n: local[n] for n in carry_names}
+
+    init = {n: env[n] for n in carry_names}
+    out = jax.lax.while_loop(cond_fn, body_fn, init)
+    # the op's Out slot lists the carry names themselves — rebind them
+    for n in carry_names:
+        env[n] = out[n]
+
+
+@register_op("conditional_block")
+def conditional_block_op(ctx: OpContext):
+    """Two-branch cond: true_block / false_block attrs, shared output names."""
+    pred = ctx.input("Cond").reshape(())
+    true_block = _sub_block(ctx, "true_block")
+    false_idx = ctx.attr("false_block", -1)
+    out_names = ctx.output_names("Out")
+    env = ctx.env
+
+    def run_branch(block):
+        def fn(_):
+            local = dict(env)
+            run_block_ops(block.ops, local, ctx.trace, offset=10_000 * block.idx)
+            return tuple(local[n] for n in out_names)
+
+        return fn
+
+    if false_idx >= 0:
+        false_block = ctx.trace.program.blocks[false_idx]
+        outs = jax.lax.cond(pred, run_branch(true_block), run_branch(false_block), None)
+    else:
+        # no else branch: outputs must already exist; keep them unchanged
+        def identity(_):
+            return tuple(env[n] for n in out_names)
+
+        outs = jax.lax.cond(pred, run_branch(true_block), identity, None)
+    for n, v in zip(out_names, outs):
+        env[n] = v
+
+
+@register_op("recurrent")
+def recurrent_op(ctx: OpContext):
+    """StaticRNN via lax.scan (reference: operators/recurrent_op.cc).
+
+    attrs: sub_block, step_inputs [(outer_name, inner_name)], memories
+    [(inner_prev_name, updated_inner_name, init_outer_name)], step_outputs
+    [inner_name...]; outputs stacked on axis 0 (time-major).
+    """
+    block = _sub_block(ctx, "sub_block")
+    step_inputs = ctx.attr("step_inputs")
+    memories = ctx.attr("memories")
+    step_outputs = ctx.attr("step_outputs")
+    env = ctx.env
+
+    xs = {inner: env[outer] for outer, inner in step_inputs}
+    init = {prev: env[init_name] for prev, _, init_name in memories}
+
+    def body(carry, x_t):
+        local = dict(env)
+        local.update(x_t)
+        local.update(carry)
+        run_block_ops(block.ops, local, ctx.trace, offset=10_000 * block.idx)
+        new_carry = {prev: local[updated] for prev, updated, _ in memories}
+        ys = tuple(local[n] for n in step_outputs)
+        return new_carry, ys
+
+    final_carry, ys = jax.lax.scan(body, init, xs)
+    ctx.set_outputs("Out", list(ys))
+    for n, v in zip(ctx.output_names("Out"), ys):
+        env[n] = v
+    for (prev, updated, _), name in zip(memories, ctx.output_names("FinalStates")):
+        env[name] = final_carry[prev]

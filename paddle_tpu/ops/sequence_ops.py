@@ -1,0 +1,286 @@
+"""Sequence ops over padded batches + lengths.
+
+The reference's signature feature is LoD (level-of-detail) tensors — ragged
+sequences stored concatenated with offset tables, consumed by 45
+``sequence_ops/`` kernels (reference: ``framework/lod_tensor.h:58-110``,
+``operators/sequence_ops/``). LoD's data-dependent shapes are fundamentally
+at odds with XLA's static-shape compilation, so the TPU-native representation
+is **padded [B, T, ...] tensors + an int Length vector [B]** (equivalently a
+mask), the standard XLA idiom (segment ids for the packed case — see
+attention_ops). Each op takes X + Length and matches the reference op's
+per-sequence semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import OpContext, register_op
+
+
+def _mask(length, maxlen, dtype=jnp.float32):
+    """[B, T] 1.0 where t < length_b."""
+    t = jnp.arange(maxlen)
+    return (t[None, :] < length.reshape(-1, 1)).astype(dtype)
+
+
+@register_op("sequence_mask")
+def sequence_mask_op(ctx: OpContext):
+    """reference: operators/sequence_ops/sequence_mask_op.cc."""
+    length = ctx.input("X").reshape(-1)
+    maxlen = ctx.attr("maxlen", -1)
+    if maxlen is None or maxlen <= 0:
+        raise ValueError(
+            "sequence_mask on TPU needs a static maxlen attr (XLA static shapes)")
+    from ..core.dtypes import to_jnp_dtype
+
+    dtype = to_jnp_dtype(ctx.attr("out_dtype", "int64"))
+    ctx.set_output("Y", _mask(length, maxlen, dtype))
+
+
+@register_op("sequence_pool")
+def sequence_pool_op(ctx: OpContext):
+    """reference: sequence_pool_op.cc — pooltype in {sum, average, sqrt, max,
+    last, first}. X: [B, T, ...], Length: [B]."""
+    x = ctx.input("X")
+    length = ctx.input("Length")
+    ptype = ctx.attr("pooltype", "average").lower()
+    B, T = x.shape[0], x.shape[1]
+    if length is None:
+        length = jnp.full((B,), T, jnp.int32)
+    length = length.reshape(-1)
+    m = _mask(length, T).reshape(B, T, *([1] * (x.ndim - 2)))
+    denom = jnp.maximum(length.astype(x.dtype), 1).reshape(B, *([1] * (x.ndim - 2)))
+    if ptype == "sum":
+        out = jnp.sum(x * m, axis=1)
+    elif ptype == "average":
+        out = jnp.sum(x * m, axis=1) / denom
+    elif ptype == "sqrt":
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(denom)
+    elif ptype == "max":
+        neg = jnp.where(m > 0, x, jnp.full_like(x, -3.4e38))
+        out = jnp.max(neg, axis=1)
+    elif ptype == "last":
+        idx = jnp.maximum(length - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape(B, 1, *([1] * (x.ndim - 2))), axis=1
+        ).squeeze(1)
+    elif ptype == "first":
+        out = x[:, 0]
+    else:
+        raise ValueError("unknown pooltype %r" % ptype)
+    ctx.set_output("Out", out)
+
+
+@register_op("sequence_softmax")
+def sequence_softmax_op(ctx: OpContext):
+    """reference: sequence_softmax_op.cc — softmax within each sequence."""
+    x = ctx.input("X")
+    length = ctx.input("Length")
+    B, T = x.shape[0], x.shape[1]
+    if length is None:
+        probs = jax.nn.softmax(x, axis=1)
+    else:
+        m = _mask(length.reshape(-1), T, jnp.bool_)
+        m = m.reshape(B, T, *([1] * (x.ndim - 2)))
+        scores = jnp.where(m, x, jnp.full_like(x, -1e9))
+        probs = jax.nn.softmax(scores, axis=1)
+        probs = jnp.where(m, probs, jnp.zeros_like(probs))
+    ctx.set_output("Out", probs)
+
+
+@register_op("sequence_reverse")
+def sequence_reverse_op(ctx: OpContext):
+    """reference: sequence_reverse_op.h — reverse each sequence's valid
+    prefix, padding stays in place."""
+    x = ctx.input("X")
+    length = ctx.input("Length")
+    B, T = x.shape[0], x.shape[1]
+    t = jnp.arange(T)
+    if length is None:
+        ctx.set_output("Y", jnp.flip(x, axis=1))
+        return
+    L = length.reshape(-1, 1)
+    idx = jnp.where(t[None, :] < L, L - 1 - t[None, :], t[None, :])
+    ctx.set_output("Y", jnp.take_along_axis(
+        x, idx.reshape(B, T, *([1] * (x.ndim - 2))), axis=1))
+
+
+@register_op("sequence_expand")
+def sequence_expand_op(ctx: OpContext):
+    """reference: sequence_expand_op.cc with ref_level semantics reduced to
+    the padded world: tile X rows per target length pattern. X: [B, D] →
+    [B, T, D] broadcast against Y's time dim."""
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    T = y.shape[1]
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], T) + x.shape[1:])
+    ctx.set_output("Out", out)
+
+
+@register_op("sequence_concat")
+def sequence_concat_op(ctx: OpContext):
+    """Concatenate along time (padded): [B,T1,D]+[B,T2,D] → [B,T1+T2,D].
+    With Lengths given, compacts each pair's valid prefixes together."""
+    xs = ctx.inputs("X")
+    lengths = ctx.inputs("Length") if ctx.has_input("Length") else None
+    if not lengths:
+        ctx.set_output("Out", jnp.concatenate(xs, axis=1))
+        return
+    B = xs[0].shape[0]
+    T_out = sum(x.shape[1] for x in xs)
+    D = xs[0].shape[2:]
+    out = jnp.zeros((B, T_out) + D, xs[0].dtype)
+    t_total = jnp.zeros((B,), jnp.int32)
+    pos = jnp.arange(T_out)
+    for x, l in zip(xs, lengths):
+        l = l.reshape(-1)
+        T = x.shape[1]
+        src_t = jnp.arange(T)
+        # scatter each sequence's prefix at offset t_total
+        tgt = t_total[:, None] + src_t[None, :]
+        valid = src_t[None, :] < l[:, None]
+        b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+        tgt_c = jnp.where(valid, tgt, T_out - 1)
+        contrib = jnp.zeros_like(out).at[b_idx.reshape(-1), tgt_c.reshape(-1)].add(
+            jnp.where(valid.reshape(B, T, *([1] * len(D))), x, 0).reshape((B * T,) + D))
+        out = out + contrib
+        t_total = t_total + l.astype(jnp.int32)
+    ctx.set_output("Out", out)
+    ctx.set_output("LengthOut", t_total)
+
+
+@register_op("sequence_pad")
+def sequence_pad_op(ctx: OpContext):
+    """reference: sequence_pad_op.cc — here X is already padded [B,T,...];
+    re-pads to padded_length with pad_value and emits Length."""
+    x = ctx.input("X")
+    length = ctx.input("Length")
+    pad_value = ctx.input("PadValue")
+    target = ctx.attr("padded_length", -1)
+    B, T = x.shape[0], x.shape[1]
+    if target is None or target <= 0:
+        target = T
+    pv = pad_value.reshape(()) if pad_value is not None else jnp.asarray(0.0, x.dtype)
+    if length is None:
+        length = jnp.full((B,), T, jnp.int32)
+    m = _mask(length.reshape(-1), T, jnp.bool_).reshape(B, T, *([1] * (x.ndim - 2)))
+    x = jnp.where(m, x, pv.astype(x.dtype))
+    if target > T:
+        pad = [(0, 0), (0, target - T)] + [(0, 0)] * (x.ndim - 2)
+        x = jnp.pad(x, pad, constant_values=pv)
+    else:
+        x = x[:, :target]
+    ctx.set_output("Out", x)
+    ctx.set_output("Length", length.reshape(-1))
+
+
+@register_op("sequence_unpad")
+def sequence_unpad_op(ctx: OpContext):
+    """reference: sequence_unpad_op.cc — zeroes padding (stays padded-shape;
+    true ragged output is not expressible under XLA)."""
+    x = ctx.input("X")
+    length = ctx.input("Length").reshape(-1)
+    T = x.shape[1]
+    m = _mask(length, T, jnp.bool_).reshape(x.shape[0], T, *([1] * (x.ndim - 2)))
+    ctx.set_output("Out", jnp.where(m, x, jnp.zeros_like(x)))
+
+
+@register_op("sequence_erase")
+def sequence_erase_op(ctx: OpContext):
+    """reference: sequence_erase_op.cc — replace listed tokens with pad (0)
+    and compact left. X: [B, T] int."""
+    x = ctx.input("X")
+    tokens = jnp.asarray(ctx.attr("tokens", []))
+    B, T = x.shape
+    keep = jnp.ones_like(x, jnp.bool_)
+    for tok in ctx.attr("tokens", []):
+        keep = keep & (x != tok)
+    # stable compaction: argsort on (not keep) puts kept items first in order
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    compacted = jnp.take_along_axis(x, order, axis=1)
+    new_len = keep.sum(axis=1)
+    m = _mask(new_len, T, jnp.bool_)
+    ctx.set_output("Out", jnp.where(m, compacted, jnp.zeros_like(compacted)))
+    ctx.set_output("Length", new_len)
+
+
+@register_op("sequence_enumerate")
+def sequence_enumerate_op(ctx: OpContext):
+    """reference: sequence_enumerate_op.cc — sliding windows of win_size."""
+    x = ctx.input("X")  # [B, T]
+    win = ctx.attr("win_size")
+    pad = ctx.attr("pad_value", 0)
+    B, T = x.shape
+    padded = jnp.pad(x, [(0, 0), (0, win - 1)], constant_values=pad)
+    out = jnp.stack([padded[:, i : i + T] for i in range(win)], axis=-1)
+    ctx.set_output("Out", out)
+
+
+@register_op("sequence_slice")
+def sequence_slice_op(ctx: OpContext):
+    """reference: sequence_slice_op.cc — per-sequence [offset, offset+length)
+    gather (output padded to max length attr)."""
+    x = ctx.input("X")
+    offset = ctx.input("Offset").reshape(-1)
+    length = ctx.input("Length").reshape(-1)
+    B, T = x.shape[0], x.shape[1]
+    out_T = ctx.attr("out_maxlen", 0) or T
+    t = jnp.arange(out_T)
+    idx = jnp.clip(offset[:, None] + t[None, :], 0, T - 1)
+    g = jnp.take_along_axis(x, idx.reshape(B, out_T, *([1] * (x.ndim - 2))), axis=1)
+    m = _mask(length, out_T, jnp.bool_).reshape(B, out_T, *([1] * (x.ndim - 2)))
+    ctx.set_output("Out", jnp.where(m, g, jnp.zeros_like(g)))
+
+
+@register_op("sequence_scatter")
+def sequence_scatter_op(ctx: OpContext):
+    """reference: sequence_scatter_op.cc — per-row scatter-add of Updates at
+    Ids positions."""
+    x = ctx.input("X")  # [B, T]
+    ids = ctx.input("Ids")  # [B, K]
+    upd = ctx.input("Updates")  # [B, K]
+    B = x.shape[0]
+    b_idx = jnp.broadcast_to(jnp.arange(B)[:, None], ids.shape)
+    out = x.at[b_idx.reshape(-1), ids.reshape(-1)].add(upd.reshape(-1))
+    ctx.set_output("Out", out)
+
+
+@register_op("sequence_expand_as")
+def sequence_expand_as_op(ctx: OpContext):
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    ctx.set_output("Out", jnp.broadcast_to(x[:, None], (x.shape[0], y.shape[1]) + x.shape[1:]))
+
+
+@register_op("im2sequence")
+def im2sequence_op(ctx: OpContext):
+    """reference: im2sequence_op.cc — image patches to sequence [B, L, khkw*C]."""
+    x = ctx.input("X")  # NCHW
+    kh, kw = ctx.attr("kernels")
+    sh, sw = ctx.attr("strides", [1, 1])
+    n, c, h, w = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patches.append(x[:, :, i : i + sh * oh : sh, j : j + sw * ow : sw])
+    stacked = jnp.stack(patches, axis=2)  # [N, C, kh*kw, oh, ow]
+    out = stacked.reshape(n, c * kh * kw, oh * ow).transpose(0, 2, 1)
+    ctx.set_output("Out", out)
+
+
+@register_op("row_conv")
+def row_conv_op(ctx: OpContext):
+    """reference: row_conv_op.cc — lookahead row convolution over [B, T, D]."""
+    x = ctx.input("X")
+    w = ctx.input("Filter")  # [future_ctx, D]
+    ctxlen = w.shape[0]
+    B, T, D = x.shape
+    out = jnp.zeros_like(x)
+    for k in range(ctxlen):
+        shifted = jnp.pad(x, [(0, 0), (0, k), (0, 0)])[:, k : k + T]
+        out = out + shifted * w[k][None, None, :]
+    ctx.set_output("Out", out)

@@ -1,0 +1,112 @@
+"""Control-flow tests (mirrors reference test_while_op.py, test_cond.py-era
+ifelse tests, test_recurrent_op.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_while_loop_sums(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant([1], "int32", 0)
+        n = fluid.layers.fill_constant([1], "int32", 10)
+        s = fluid.layers.fill_constant([1], "float32", 0.0)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with w.block():
+            fluid.layers.assign(fluid.layers.cast(i, "float32") + s, s)
+            fluid.layers.increment(i, value=1, in_place=True)
+            fluid.layers.less_than(i, n, cond=cond)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    out, iv = exe.run(main, feed={}, fetch_list=[s, i])
+    assert float(out.item()) == sum(range(10))
+    assert int(iv.item()) == 10
+
+
+def test_while_requires_condition_update():
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        i = fluid.layers.fill_constant([1], "int32", 0)
+        n = fluid.layers.fill_constant([1], "int32", 10)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with pytest.raises(ValueError, match="infinite loop"):
+            with w.block():
+                fluid.layers.increment(i, in_place=True)
+
+
+def test_cond_branches(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2])
+        flag = fluid.layers.data("flag", shape=[], dtype="bool",
+                                 append_batch_size=False)
+        out = fluid.layers.cond(
+            flag,
+            lambda: fluid.layers.scale(x, scale=2.0),
+            lambda: fluid.layers.scale(x, scale=-1.0),
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = rng.randn(3, 2).astype("float32")
+    t, = exe.run(main, feed={"x": xs, "flag": np.array(True)}, fetch_list=[out])
+    f, = exe.run(main, feed={"x": xs, "flag": np.array(False)}, fetch_list=[out])
+    np.testing.assert_allclose(t, 2 * xs, rtol=1e-6)
+    np.testing.assert_allclose(f, -xs, rtol=1e-6)
+
+
+def test_static_rnn_accumulates(rng):
+    T, B, D = 5, 3, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[B, D], append_batch_size=False)
+        # time-major input built by stacking the same row T times via feed
+        x_tm = fluid.layers.data("x_tm", shape=[T, B, D], append_batch_size=False)
+        h0 = fluid.layers.fill_constant([B, D], "float32", 0.0)
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            w = rnn.step_input(x_tm)
+            prev = rnn.memory(init=h0)
+            nxt = fluid.layers.elementwise_add(w, prev)
+            rnn.update_memory(prev, nxt)
+            rnn.step_output(nxt)
+        outs = rnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = rng.randn(T, B, D).astype("float32")
+    got, = exe.run(main, feed={"x": xs[0], "x_tm": xs}, fetch_list=[outs])
+    want = np.cumsum(xs, axis=0)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_static_rnn_trains(rng):
+    """RNN through lax.scan must be differentiable end-to-end."""
+    T, B, D, H = 4, 8, 6, 5
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x_tm = fluid.layers.data("x", shape=[T, B, D], append_batch_size=False)
+        y = fluid.layers.data("y", shape=[B, 1], dtype="int64", append_batch_size=False)
+        h0 = fluid.layers.fill_constant([B, H], "float32", 0.0)
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            w = rnn.step_input(x_tm)
+            prev = rnn.memory(init=h0)
+            h = fluid.layers.fc([w, prev], size=H, act="tanh")
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        outs = rnn()  # [T, B, H]
+        last = fluid.layers.slice(outs, axes=[0], starts=[T - 1], ends=[T])
+        last = fluid.layers.squeeze(last, axes=[0])
+        logits = fluid.layers.fc(last, size=3)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = rng.randn(T, B, D).astype("float32")
+    ys = rng.randint(0, 3, (B, 1)).astype("int64")
+    losses = [float(exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])[0])
+              for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])

@@ -1,0 +1,146 @@
+"""Sequence op tests over padded+Length representation (mirrors the
+reference's sequence_ops/ test files: test_sequence_pool.py,
+test_sequence_reverse.py, test_sequence_softmax_op.py, ...)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.testing import check_output, run_op
+
+
+@pytest.fixture
+def r():
+    return np.random.RandomState(3)
+
+
+def test_sequence_mask():
+    length = np.array([2, 0, 3], "int64")
+    want = np.array([[1, 1, 0], [0, 0, 0], [1, 1, 1]], "float32")
+    check_output("sequence_mask", {"X": length}, {"Y": want},
+                 attrs={"maxlen": 3, "out_dtype": "float32"})
+
+
+def test_sequence_pool_all_types(r):
+    x = r.randn(3, 4, 2).astype("float32")
+    length = np.array([2, 4, 1], "int64")
+    m = (np.arange(4)[None, :] < length[:, None]).astype("float32")[..., None]
+    xm = x * m
+    check_output("sequence_pool", {"X": x, "Length": length},
+                 {"Out": xm.sum(1)}, attrs={"pooltype": "sum"}, atol=1e-5)
+    check_output("sequence_pool", {"X": x, "Length": length},
+                 {"Out": xm.sum(1) / length[:, None]},
+                 attrs={"pooltype": "average"}, atol=1e-5)
+    check_output("sequence_pool", {"X": x, "Length": length},
+                 {"Out": xm.sum(1) / np.sqrt(length[:, None])},
+                 attrs={"pooltype": "sqrt"}, atol=1e-5)
+    want_max = np.where(m > 0, x, -np.inf).max(1)
+    check_output("sequence_pool", {"X": x, "Length": length},
+                 {"Out": want_max}, attrs={"pooltype": "max"}, atol=1e-5)
+    want_last = x[np.arange(3), length - 1]
+    check_output("sequence_pool", {"X": x, "Length": length},
+                 {"Out": want_last}, attrs={"pooltype": "last"}, atol=1e-6)
+    check_output("sequence_pool", {"X": x, "Length": length},
+                 {"Out": x[:, 0]}, attrs={"pooltype": "first"}, atol=1e-6)
+
+
+def test_sequence_softmax_masks_padding(r):
+    x = r.randn(2, 4).astype("float32")
+    length = np.array([3, 2], "int64")
+    out = np.asarray(run_op("sequence_softmax", {"X": x, "Length": length}, ["Out"])["Out"])
+    np.testing.assert_allclose(out.sum(1), [1.0, 1.0], atol=1e-5)
+    assert out[0, 3] == 0 and out[1, 2] == 0 and out[1, 3] == 0
+    e = np.exp(x[0, :3] - x[0, :3].max())
+    np.testing.assert_allclose(out[0, :3], e / e.sum(), atol=1e-5)
+
+
+def test_sequence_reverse(r):
+    x = np.arange(12).reshape(2, 6).astype("float32")
+    length = np.array([4, 6], "int64")
+    out = np.asarray(run_op("sequence_reverse", {"X": x, "Length": length}, ["Y"])["Y"])
+    np.testing.assert_array_equal(out[0], [3, 2, 1, 0, 4, 5])
+    np.testing.assert_array_equal(out[1], [11, 10, 9, 8, 7, 6])
+
+
+def test_sequence_pad_unpad(r):
+    x = r.randn(2, 3, 2).astype("float32")
+    length = np.array([2, 3], "int64")
+    out = run_op("sequence_pad",
+                 {"X": x, "Length": length, "PadValue": np.array(9.0, "float32")},
+                 ["Out", "Length"], attrs={"padded_length": 5})
+    got = np.asarray(out["Out"])
+    assert got.shape == (2, 5, 2)
+    np.testing.assert_allclose(got[0, :2], x[0, :2])
+    assert (got[0, 2:] == 9.0).all() and (got[1, 3:] == 9.0).all()
+
+    up = np.asarray(run_op("sequence_unpad", {"X": x, "Length": length}, ["Out"])["Out"])
+    assert (up[0, 2:] == 0).all()
+    np.testing.assert_allclose(up[1], x[1])
+
+
+def test_sequence_erase_and_enumerate():
+    x = np.array([[1, 2, 3, 2, 5], [2, 2, 2, 4, 0]], "int64")
+    out = run_op("sequence_erase", {"X": x}, ["Out", "Length"], attrs={"tokens": [2]})
+    got = np.asarray(out["Out"])
+    np.testing.assert_array_equal(got[0], [1, 3, 5, 0, 0])
+    np.testing.assert_array_equal(got[1], [4, 0, 0, 0, 0])
+    np.testing.assert_array_equal(np.asarray(out["Length"]), [3, 2])
+
+    e = np.asarray(run_op("sequence_enumerate", {"X": x}, ["Out"],
+                          attrs={"win_size": 2, "pad_value": 0})["Out"])
+    np.testing.assert_array_equal(e[0, 0], [1, 2])
+    np.testing.assert_array_equal(e[0, 4], [5, 0])
+
+
+def test_sequence_slice_scatter(r):
+    x = np.arange(20).reshape(2, 10).astype("float32")
+    out = np.asarray(run_op("sequence_slice",
+                            {"X": x, "Offset": np.array([2, 5], "int64"),
+                             "Length": np.array([3, 2], "int64")},
+                            ["Out"], attrs={"out_maxlen": 4})["Out"])
+    np.testing.assert_array_equal(out[0], [2, 3, 4, 0])
+    np.testing.assert_array_equal(out[1], [15, 16, 0, 0])
+
+    base = np.zeros((2, 5), "float32")
+    ids = np.array([[1, 3], [0, 0]], "int64")
+    upd = np.array([[1.0, 2.0], [5.0, 7.0]], "float32")
+    got = np.asarray(run_op("sequence_scatter",
+                            {"X": base, "Ids": ids, "Updates": upd}, ["Out"])["Out"])
+    np.testing.assert_array_equal(got[0], [0, 1, 0, 2, 0])
+    np.testing.assert_array_equal(got[1], [12, 0, 0, 0, 0])
+
+
+def test_im2sequence_and_row_conv(r):
+    x = r.randn(1, 2, 4, 4).astype("float32")
+    out = np.asarray(run_op("im2sequence", {"X": x}, ["Out"],
+                            attrs={"kernels": [2, 2], "strides": [1, 1]})["Out"])
+    assert out.shape == (1, 9, 8)
+    # first patch contains the 2x2 window of both channels
+    patch0 = set(np.round(out[0, 0], 5).tolist())
+    want0 = set(np.round(x[0, :, :2, :2].reshape(-1), 5).tolist())
+    assert patch0 == want0
+
+    seq = r.randn(2, 5, 3).astype("float32")
+    w = r.randn(3, 3).astype("float32")
+    got = np.asarray(run_op("row_conv", {"X": seq, "Filter": w}, ["Out"])["Out"])
+    want = np.zeros_like(seq)
+    for k in range(3):
+        shifted = np.pad(seq, [(0, 0), (0, k), (0, 0)])[:, k:k + 5]
+        want += shifted * w[k][None, None, :]
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_sequence_layers_in_program(rng):
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[6, 4], append_batch_size=True)
+        length = fluid.layers.data("len", shape=[], dtype="int64")
+        pooled = fluid.layers.sequence_pool(x, "average", length=length)
+        out = fluid.layers.fc(pooled, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = rng.randn(3, 6, 4).astype("float32")
+    ls = np.array([6, 2, 4], "int64")
+    got, = exe.run(main, feed={"x": xs, "len": ls}, fetch_list=[out])
+    assert got.shape == (3, 2) and np.isfinite(got).all()
