@@ -1,0 +1,101 @@
+"""Ring attention — sequence/context parallelism over the mesh ``sp`` axis.
+
+The reference has no sequence parallelism (SURVEY §5.7: LoD is its only long-
+sequence story). This is the TPU-native long-context design: the sequence
+dim is sharded across devices; each device computes attention for its Q shard
+while K/V blocks rotate around the ICI ring via ``lax.ppermute``, merging
+per-block results with streaming (online) softmax — memory per device is
+O(S/n · S/n) per step instead of O(S²), and comm overlaps compute around the
+ring. Differentiable (lax.scan carries, not while_loop), so it is the
+training path for long sequences.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core.registry import OpContext, register_op
+
+__all__ = ["ring_attention"]
+
+_NEG_INF = -1e30
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, sm_scale: float):
+    """Per-device body under shard_map. q/k/v: [B, H, S_local, D] shards."""
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[2]
+
+    qf = q.astype(jnp.float32) * sm_scale
+    pos_q = my_idx * s_local + jnp.arange(s_local)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        k_blk, v_blk, o, m, l = carry
+        src_block = (my_idx - i) % n
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32))
+        if causal:
+            pos_k = src_block * s_local + jnp.arange(s_local)
+            mask = pos_k[None, None, None, :] <= pos_q[None, None, :, None]
+            scores = jnp.where(mask, scores, _NEG_INF)
+        blk_max = jnp.max(scores, axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        # rescale the running accumulators to the new max
+        alpha = jnp.exp(m - new_m)
+        p = jnp.exp(scores - new_m[..., None])
+        new_l = l * alpha + jnp.sum(p, axis=-1)
+        new_o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        # rotate K/V to the next device on the ring
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_next, v_next, new_o, new_m, new_l), None
+
+    o0 = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
+    m0 = jnp.full(q.shape[:3], _NEG_INF, jnp.float32)
+    l0 = jnp.zeros(q.shape[:3], jnp.float32)
+    (kf, vf, o, m, l), _ = jax.lax.scan(
+        step, (k, v, o0, m0, l0), jnp.arange(n))
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
+                   causal: bool = False, sm_scale: float = 1.0,
+                   batch_axis: Optional[str] = None):
+    """Sequence-parallel attention over logically-global [B, H, S, D] arrays
+    whose S dim is sharded on ``axis_name``. Call under jit with the mesh."""
+    shard_map = jax.shard_map
+
+    if batch_axis is None:
+        batch_axis = "data" if "data" in mesh.axis_names else None
+    spec = P(batch_axis, None, axis_name, None)
+    fn = functools.partial(
+        _ring_attention_local, axis_name=axis_name, causal=causal,
+        sm_scale=sm_scale)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)
+
+
+@register_op("ring_attention")
+def ring_attention_op(ctx: OpContext):
+    """Graph-level op: uses the trace mesh's ``sp`` axis; falls back to the
+    fused single-device attention when no sp axis is available."""
+    q, k, v = ctx.input("Q"), ctx.input("K"), ctx.input("V")
+    causal = ctx.attr("causal", False)
+    sm_scale = ctx.attr("sm_scale", 1.0)
+    mesh = getattr(ctx.trace, "mesh", None)
+    if mesh is None or "sp" not in mesh.axis_names:
+        from ..ops.attention_ops import sdpa
+
+        ctx.set_output("Out", sdpa(q, k, v, causal=causal, sm_scale=sm_scale))
+        return
+    ctx.set_output("Out", ring_attention(q, k, v, mesh, causal=causal,
+                                         sm_scale=sm_scale))
