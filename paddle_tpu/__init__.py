@@ -18,6 +18,7 @@ Typical use mirrors Fluid:
 """
 
 from . import (  # noqa: F401
+    amp,
     backward,
     clip,
     dataset,

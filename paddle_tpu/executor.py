@@ -54,9 +54,12 @@ class TraceContext:
 
 
 def _canon(value, dtype_name: str):
-    arr = np.asarray(value)
     target = to_jnp_dtype(dtype_name)
     canonical = jax.dtypes.canonicalize_dtype(target)
+    if isinstance(value, jax.Array):
+        # already on device (e.g. via DevicePrefetcher) — never round-trip to host
+        return value if value.dtype == canonical else value.astype(canonical)
+    arr = np.asarray(value)
     if arr.dtype != canonical:
         arr = arr.astype(canonical)
     return arr
@@ -92,11 +95,28 @@ class _CompiledStep:
                     break
         accum = max(1, int(accumulation_steps)) if marker_idx is not None else 1
 
+        # AMP: run the forward in bf16/fp16 against fp32 master weights
+        # (the TPU-native float16.h story; enabled via paddle_tpu.amp).
+        amp_dtype = getattr(program, "_amp_dtype", None)
+        if amp_dtype is not None:
+            amp_dtype = to_jnp_dtype(amp_dtype)
+
+        def _amp_cast_tree(d):
+            if amp_dtype is None:
+                return d
+            return {
+                k: (v.astype(amp_dtype)
+                    if hasattr(v, "dtype") and v.dtype == jnp.float32 else v)
+                for k, v in d.items()
+            }
+
         def step(state, feeds, rng_key):
             trace = TraceContext(program, is_test, rng_key, mesh=mesh)
             if bw is None or marker_idx is None:
                 env = dict(state)
-                env.update(feeds)
+                env.update(_amp_cast_tree(feeds))
+                if amp_dtype is not None:
+                    env = _amp_cast_tree(env)
                 run_block_ops(ops, env, trace)
             else:
                 loss_name = bw["loss"]
@@ -109,10 +129,10 @@ class _CompiledStep:
 
                 def fwd(params_in, feeds_in):
                     env = dict(rest)
-                    env.update(params_in)
-                    env.update(feeds_in)
+                    env.update(_amp_cast_tree(params_in))
+                    env.update(_amp_cast_tree(feeds_in))
                     run_block_ops(fwd_ops, env, trace)
-                    loss = jnp.sum(env[loss_name])
+                    loss = jnp.sum(env[loss_name].astype(jnp.float32))
                     return loss, env
 
                 if accum == 1:
@@ -136,6 +156,9 @@ class _CompiledStep:
                         loss_sum = li if loss_sum is None else loss_sum + li
                     grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
                     env[loss_name] = loss_sum / accum
+                # restore fp32 master params for the optimizer ops (the env
+                # holds their amp-cast forward copies)
+                env.update(params)
                 for p in param_names:
                     env[param_to_grad[p]] = grads[p]
                 env[grad_var_name(loss_name)] = jnp.ones_like(jnp.sum(env[loss_name]))

@@ -1,0 +1,2 @@
+from .predictor import AnalysisConfig, Predictor, create_predictor  # noqa: F401
+from .export import export_stablehlo, load_stablehlo  # noqa: F401

@@ -1,0 +1,123 @@
+"""Inference predictor API.
+
+Reference: ``PaddlePredictor``/``CreatePaddlePredictor``
+(``inference/api/paddle_api.h:186,314``) and ``AnalysisPredictor``
+(``api/analysis_predictor.cc:183,337``). The reference loads a ProgramDesc,
+runs an IR-pass fusion pipeline, and executes with NaiveExecutor. Here the
+saved program desc is loaded and jit-compiled whole — XLA performs the
+fusions the reference's analysis passes hand-roll (conv+bn fold, fc fuse,
+transpose-flatten-concat, ...) — with a compile cache keyed on input shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..core.scope import Scope, scope_guard
+
+__all__ = ["AnalysisConfig", "Predictor", "create_predictor"]
+
+
+class AnalysisConfig:
+    """reference: inference/api/paddle_analysis_config.h:34."""
+
+    def __init__(self, model_dir: Optional[str] = None,
+                 prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        self.model_dir = model_dir
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._use_tpu = True
+        self._ir_optim = True  # accepted; XLA always optimizes
+        self._memory_optim = True
+
+    # GPU-era API parity: the accelerator here is the TPU.
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_tpu = True
+
+    enable_use_tpu = enable_use_gpu
+
+    def disable_gpu(self):
+        self._use_tpu = False
+
+    def switch_ir_optim(self, x: bool = True):
+        self._ir_optim = x
+
+    def enable_memory_optim(self, x: bool = True):
+        self._memory_optim = x
+
+    def use_gpu(self) -> bool:
+        return self._use_tpu
+
+
+class _IOHandle:
+    """Zero-copy-style tensor handle (reference: ZeroCopyTensor)."""
+
+    def __init__(self, name: str, owner: "Predictor", is_input: bool):
+        self.name = name
+        self._owner = owner
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._owner._staged_inputs[self.name] = np.asarray(arr)
+
+    def reshape(self, shape):
+        pass  # shapes come from the array itself
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._owner._last_outputs[self.name])
+
+
+class Predictor:
+    def __init__(self, config: AnalysisConfig):
+        from .. import io as io_mod
+        from ..executor import Executor
+        from ..core.place import CPUPlace, TPUPlace
+
+        self.config = config
+        self._scope = Scope()
+        place = TPUPlace(0) if config.use_gpu() else CPUPlace()
+        self._exe = Executor(place)
+        with scope_guard(self._scope):
+            self._program, self._feed_names, self._fetch_names = (
+                io_mod.load_inference_model(
+                    config.model_dir, self._exe,
+                    model_filename=config.prog_file,
+                    params_filename=config.params_file))
+        self._staged_inputs: Dict[str, np.ndarray] = {}
+        self._last_outputs: Dict[str, np.ndarray] = {}
+
+    # -- modern handle API ----------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    def get_input_handle(self, name: str) -> _IOHandle:
+        return _IOHandle(name, self, True)
+
+    def get_output_handle(self, name: str) -> _IOHandle:
+        return _IOHandle(name, self, False)
+
+    # -- execution ------------------------------------------------------------
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        """run([x1, x2, ...]) positional over feed names, or run() after
+        staging via input handles. Returns outputs in fetch order."""
+        if inputs is not None:
+            feed = {n: np.asarray(a) for n, a in zip(self._feed_names, inputs)}
+        else:
+            feed = dict(self._staged_inputs)
+        with scope_guard(self._scope):
+            outs = self._exe.run(self._program, feed=feed,
+                                 fetch_list=self._fetch_names)
+        self._last_outputs = dict(zip(self._fetch_names, outs))
+        return outs
+
+
+def create_predictor(config: AnalysisConfig) -> Predictor:
+    """reference: CreatePaddlePredictor (paddle_api.h:314)."""
+    return Predictor(config)

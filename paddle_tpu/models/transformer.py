@@ -153,9 +153,16 @@ def transformer(
     logits = layers.fc(dec_out, size=trg_vocab_size, num_flatten_dims=2,
                        name="predict")
     if label_smooth_eps and not is_test:
-        smoothed = layers.label_smooth(
-            layers.one_hot(trg_labels, trg_vocab_size), epsilon=label_smooth_eps)
-        per_tok = layers.softmax_with_cross_entropy(logits, smoothed, soft_label=True)
+        # -(q · log p) with q = (1-eps)·onehot + eps/K, computed WITHOUT
+        # materializing the [B, S, V] one-hot (HBM-bandwidth killer):
+        # (1-eps)·CE(label) + eps/K · Σ(-log p)
+        ce = layers.softmax_with_cross_entropy(logits, trg_labels)
+        neg_logsum = tl.scale(
+            layers.reduce_sum(layers.log_softmax(logits), dim=-1, keep_dim=True),
+            scale=-1.0)
+        per_tok = layers.elementwise_add(
+            tl.scale(ce, scale=1.0 - label_smooth_eps),
+            tl.scale(neg_logsum, scale=label_smooth_eps / trg_vocab_size))
     else:
         per_tok = layers.softmax_with_cross_entropy(logits, trg_labels)
     # mask out padding positions; normalize by token count

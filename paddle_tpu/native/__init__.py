@@ -1,0 +1,35 @@
+"""Native (C++) components, built on demand with g++ and loaded via ctypes.
+
+The reference keeps its data plane native (recordio/, buffered_reader.cc,
+data_feed.cc); this package is the TPU build's native layer. Build artifacts
+land next to the sources and are reused across sessions.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIBS = {}
+
+
+def build_and_load(name: str) -> ctypes.CDLL:
+    """Compile ``<name>.cc`` into ``lib<name>.so`` (if stale) and dlopen it."""
+    with _LOCK:
+        if name in _LIBS:
+            return _LIBS[name]
+        src = os.path.join(_HERE, name + ".cc")
+        so = os.path.join(_HERE, "lib%s.so" % name)
+        if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+            cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src, "-o", so]
+            r = subprocess.run(cmd, capture_output=True, text=True)
+            if r.returncode != 0:
+                raise RuntimeError(
+                    "native build failed: %s\n%s" % (" ".join(cmd), r.stderr))
+        lib = ctypes.CDLL(so)
+        _LIBS[name] = lib
+        return lib

@@ -16,8 +16,17 @@ from ..core.dtypes import to_jnp_dtype
 from ..core.registry import OpContext, register_op
 
 
+def _dim_prod(dims):
+    """Product of dims that stays symbolic under jax.export shape polymorphism
+    (int()/np.prod would force symbolic dims to constants)."""
+    p = 1
+    for d in dims:
+        p = p * d
+    return p
+
+
 def _flatten_to_2d(x, num_col_dims: int):
-    lead = int(np.prod(x.shape[:num_col_dims])) if num_col_dims > 0 else 1
+    lead = _dim_prod(x.shape[:num_col_dims]) if num_col_dims > 0 else 1
     return x.reshape(lead, -1)
 
 
@@ -28,7 +37,7 @@ def mul_op(ctx: OpContext):
     xd = ctx.attr("x_num_col_dims", 1)
     yd = ctx.attr("y_num_col_dims", 1)
     x2 = _flatten_to_2d(x, xd)
-    y2 = y.reshape(int(np.prod(y.shape[:yd])), -1)
+    y2 = y.reshape(_dim_prod(y.shape[:yd]), -1)
     out2 = jnp.matmul(x2, y2)
     out_shape = x.shape[:xd] + y.shape[yd:]
     ctx.set_output("Out", out2.reshape(out_shape))

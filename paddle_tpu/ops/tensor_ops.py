@@ -67,9 +67,11 @@ def unsqueeze_op(ctx: OpContext):
 
 @register_op("flatten", "flatten2")
 def flatten_op(ctx: OpContext):
+    from .math_ops import _dim_prod
+
     x = ctx.input("X")
     axis = ctx.attr("axis", 1)
-    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    lead = _dim_prod(x.shape[:axis]) if axis > 0 else 1
     ctx.set_output("Out", x.reshape(lead, -1))
     if ctx.has_output("XShape"):
         ctx.set_output("XShape", jnp.zeros((0,) + x.shape, dtype=x.dtype))

@@ -1,0 +1,85 @@
+"""Mixed-precision tests: bf16 forward with fp32 master weights."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _build():
+    x = fluid.layers.data("x", shape=[16])
+    y = fluid.layers.data("y", shape=[1], dtype="int64")
+    h = fluid.layers.fc(x, size=32, act="relu")
+    logits = fluid.layers.fc(h, size=4)
+    loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(logits, y))
+    return h, logits, loss
+
+
+def test_amp_trains_and_keeps_fp32_masters(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        h, logits, loss = _build()
+        opt = fluid.amp.decorate(fluid.optimizer.Adam(1e-2))
+        opt.minimize(loss)
+    assert main._amp_dtype == "bfloat16"
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = rng.randn(64, 16).astype("float32")
+    ys = rng.randint(0, 4, (64, 1)).astype("int64")
+    losses, acts = [], None
+    for _ in range(20):
+        l, a = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss, h],
+                       return_numpy=False)
+        losses.append(float(np.asarray(l)))
+    # forward activations are bf16; master weights in scope stay fp32
+    assert a.dtype == jnp.bfloat16
+    w = fluid.global_scope().find_var("fc_0.w_0")
+    assert w.dtype == jnp.float32
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_amp_loss_close_to_fp32(rng):
+    xs = rng.randn(32, 16).astype("float32")
+    ys = rng.randint(0, 4, (32, 1)).astype("int64")
+
+    def run(use_amp):
+        with fluid.unique_name.guard():
+            with fluid.scope_guard(fluid.Scope()):
+                main, startup = fluid.Program(), fluid.Program()
+                main.random_seed = startup.random_seed = 3
+                with fluid.program_guard(main, startup):
+                    h, logits, loss = _build()
+                    opt = fluid.optimizer.SGD(0.05)
+                    if use_amp:
+                        opt = fluid.amp.decorate(opt)
+                    opt.minimize(loss)
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                return [float(exe.run(main, feed={"x": xs, "y": ys},
+                                      fetch_list=[loss])[0]) for _ in range(5)]
+
+    fp32 = run(False)
+    bf16 = run(True)
+    np.testing.assert_allclose(fp32, bf16, rtol=0.05, atol=0.02)
+
+
+def test_amp_static_loss_scaling_matches_unscaled(rng):
+    xs = rng.randn(32, 16).astype("float32")
+    ys = rng.randint(0, 4, (32, 1)).astype("int64")
+
+    def run(scale):
+        with fluid.unique_name.guard():
+            with fluid.scope_guard(fluid.Scope()):
+                main, startup = fluid.Program(), fluid.Program()
+                main.random_seed = startup.random_seed = 3
+                with fluid.program_guard(main, startup):
+                    h, logits, loss = _build()
+                    opt = fluid.amp.decorate(fluid.optimizer.SGD(0.05),
+                                             init_loss_scaling=scale)
+                    opt.minimize(loss)
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                return [float(exe.run(main, feed={"x": xs, "y": ys},
+                                      fetch_list=[loss])[0]) for _ in range(5)]
+
+    np.testing.assert_allclose(run(1.0), run(128.0), rtol=0.02, atol=0.01)
