@@ -32,6 +32,7 @@ from . import (  # noqa: F401
     profiler,
     reader,
     regularizer,
+    transpiler,
 )
 from .data_feeder import DataFeeder  # noqa: F401
 from .flags import flags, get_flag, set_flag  # noqa: F401

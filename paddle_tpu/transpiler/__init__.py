@@ -1,0 +1,2 @@
+from .distribute_transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
+from .memory_optimization import memory_optimize, release_memory  # noqa: F401

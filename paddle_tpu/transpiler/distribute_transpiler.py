@@ -1,0 +1,106 @@
+"""DistributeTranspiler compatibility shim.
+
+Reference: ``python/paddle/fluid/transpiler/distribute_transpiler.py:161``
+(2078 lines rewriting programs into trainer/pserver pairs with send/recv ops,
+sliced param blocks, and barriers) plus its NCCL2 mode (``:226``).
+
+On TPU none of that program surgery exists: collectives are inserted by
+XLA/GSPMD from sharding annotations, multi-host bootstrap is
+``parallel.init_distributed`` (replacing gen_nccl_id), and the parameter
+server's sharded tables are row-sharded Parameters
+(``parallel.sharded_embedding``/``annotate_sharding``). This class keeps the
+reference's launch-script surface working:
+
+- NCCL2 mode → no-op transpile (the program is already collective-ready);
+  ``get_trainer_program`` returns it unchanged.
+- pserver mode → ``transpile`` succeeds (trainer side unchanged);
+  ``get_pserver_program`` raises with migration guidance, since there is no
+  pserver process in the TPU architecture.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core.framework import Program, default_main_program, default_startup_program
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig"]
+
+
+class DistributeTranspilerConfig:
+    """reference: distribute_transpiler.py:130 — accepted for compatibility."""
+
+    slice_var_up = True
+    split_method = None
+    min_block_size = 8192
+    enable_dc_asgd = False
+    sync_mode = True
+    runtime_split_send_recv = False
+    mode = "pserver"
+
+
+class DistributeTranspiler:
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._program: Optional[Program] = None
+        self._startup: Optional[Program] = None
+        self._trainer_id = 0
+        self._trainers = 1
+        self._sync_mode = True
+        self._mode = "pserver"
+
+    def transpile(
+        self,
+        trainer_id: int,
+        program: Optional[Program] = None,
+        pservers: str = "",
+        trainers=1,
+        sync_mode: bool = True,
+        startup_program: Optional[Program] = None,
+        current_endpoint: str = "",
+    ):
+        """reference: distribute_transpiler.py:280. ``trainers`` may be an
+        int (pserver mode) or an endpoint list string (NCCL2 mode)."""
+        self._program = program or default_main_program()
+        self._startup = startup_program or default_startup_program()
+        self._trainer_id = trainer_id
+        self._sync_mode = sync_mode
+        if isinstance(trainers, str) or self.config.mode == "nccl2":
+            self._mode = "collective"
+            eps = trainers.split(",") if isinstance(trainers, str) else []
+            self._trainers = len(eps) or int(trainers or 1)
+        else:
+            self._mode = "pserver"
+            self._trainers = int(trainers)
+        # No program rewriting: gradient synchronization is inserted by
+        # XLA/GSPMD when the program runs on a multi-process mesh after
+        # parallel.init_distributed().
+        return self._program
+
+    def get_trainer_program(self, wait_port: bool = True) -> Program:
+        """reference: :554 — the trainer program is the original program."""
+        if self._program is None:
+            raise RuntimeError("call transpile() first")
+        return self._program
+
+    def get_pserver_program(self, endpoint: str) -> Program:
+        """reference: :674 — intentionally unsupported."""
+        raise NotImplementedError(
+            "There is no parameter-server process in the TPU architecture: "
+            "dense state is replicated or sharded over the device mesh "
+            "(CompiledProgram.with_mesh + parallel.annotate_sharding) and "
+            "sparse tables are row-sharded embeddings "
+            "(parallel.sharded_embedding). Launch every host as a trainer "
+            "with parallel.init_distributed()."
+        )
+
+    def get_pserver_programs(self, endpoint: str):
+        return self.get_pserver_program(endpoint)
+
+    def get_startup_program(self, endpoint: str = "", pserver_program=None,
+                            startup_program=None) -> Program:
+        """reference: :927 — the shared startup program works for every host
+        (param init is deterministic and replicated)."""
+        if self._startup is None:
+            raise RuntimeError("call transpile() first")
+        return self._startup

@@ -1,0 +1,20 @@
+"""Memory-optimization transpiler shims (reference:
+transpiler/memory_optimization_transpiler.py — liveness-based var reuse).
+
+XLA's buffer assignment performs this optimization (and more: liveness,
+aliasing, donation) on every compile, so these are accepted no-ops kept for
+script compatibility.
+"""
+
+from __future__ import annotations
+
+__all__ = ["memory_optimize", "release_memory"]
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=False):
+    return input_program
+
+
+def release_memory(input_program, skip_opt_set=None):
+    return input_program

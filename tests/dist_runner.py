@@ -1,0 +1,68 @@
+"""Subprocess entry for the multi-process distributed test
+(the role of the reference's dist_mnist.py run under test_dist_base.py).
+
+Each process joins the jax.distributed cluster, builds the same program,
+and trains data-parallel over the GLOBAL mesh spanning both processes —
+the TPU-native analog of the reference's 2-trainer NCCL2 mode.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+from jax._src import xla_bridge as _xb
+
+if _xb.backends_are_initialized():
+    _xb._clear_backends()
+
+import numpy as np
+
+
+def main():
+    pid = int(os.environ["PADDLE_TRAINER_ID"])
+    n = int(os.environ["PADDLE_TRAINERS_NUM"])
+
+    import paddle_tpu as fluid
+
+    if n > 1:
+        fluid.parallel.init_distributed()
+        assert jax.process_count() == n
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main_prog, startup):
+        x = fluid.layers.data("x", shape=[8])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        logits = fluid.layers.fc(h, size=4)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+
+    # NCCL2-style transpile is a no-op but must keep the script contract
+    t = fluid.transpiler.DistributeTranspiler()
+    t.transpile(trainer_id=pid, program=main_prog, trainers=os.environ.get(
+        "PADDLE_TRAINER_ENDPOINTS", str(n)))
+    main_prog = t.get_trainer_program()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    prog = fluid.CompiledProgram(main_prog).with_data_parallel(loss_name=loss.name)
+    rng = np.random.RandomState(0)  # same global data on every process
+    losses = []
+    for step in range(5):
+        xs = rng.randn(8, 8).astype("float32")
+        ys = rng.randint(0, 4, (8, 1)).astype("int64")
+        l, = exe.run(prog, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(round(float(np.asarray(l)), 6))
+    print("DIST_LOSSES:%d:%s" % (pid, ",".join(map(str, losses))), flush=True)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

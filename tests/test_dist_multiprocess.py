@@ -1,0 +1,58 @@
+"""Multi-process distributed training test without a real cluster
+(reference: test_dist_base.py — 2 trainers as localhost subprocesses,
+dist losses asserted against local losses).
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+_RUNNER = os.path.join(os.path.dirname(__file__), "dist_runner.py")
+
+
+def _launch(pid, n, port, extra_env=None):
+    env = dict(os.environ)
+    env.pop("PYTEST_CURRENT_TEST", None)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PADDLE_TRAINER_ID"] = str(pid)
+    env["PADDLE_TRAINERS_NUM"] = str(n)
+    env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(
+        "127.0.0.1:%d" % (port + i) for i in range(n))
+    env.update(extra_env or {})
+    return subprocess.Popen([sys.executable, _RUNNER], env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                            text=True)
+
+
+def _losses_from(out: str, pid: int):
+    m = re.search(r"DIST_LOSSES:%d:([\d.,\-e]+)" % pid, out)
+    assert m, "runner %d produced no losses; output:\n%s" % (pid, out)
+    return [float(v) for v in m.group(1).split(",")]
+
+
+def test_two_process_data_parallel_matches_single():
+    # single-process reference run
+    p = _launch(0, 1, 23450)
+    out, _ = p.communicate(timeout=300)
+    assert p.returncode == 0, out
+    single = _losses_from(out, 0)
+
+    # two processes over one global mesh (reference: _run_cluster :344)
+    p0 = _launch(0, 2, 23460)
+    p1 = _launch(1, 2, 23460)
+    out0, _ = p0.communicate(timeout=300)
+    out1, _ = p1.communicate(timeout=300)
+    assert p0.returncode == 0, out0
+    assert p1.returncode == 0, out1
+    l0 = _losses_from(out0, 0)
+    l1 = _losses_from(out1, 1)
+    assert l0 == l1, (l0, l1)  # same replicated loss on both processes
+
+    for s, d in zip(single, l0):
+        assert abs(s - d) < 1e-4, (single, l0)
+    assert l0[-1] < l0[0]
