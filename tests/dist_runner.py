@@ -2,8 +2,19 @@
 (the role of the reference's dist_mnist.py run under test_dist_base.py).
 
 Each process joins the jax.distributed cluster, builds the same program,
-and trains data-parallel over the GLOBAL mesh spanning both processes —
-the TPU-native analog of the reference's 2-trainer NCCL2 mode.
+and trains over the GLOBAL mesh spanning all processes — the TPU-native
+analog of the reference's multi-trainer NCCL2 mode.
+
+Modes (DIST_MODE env):
+  dp     — pure data parallel over a 1-axis mesh (default)
+  dp_tp  — 2-D mesh {'data': n, 'model': 2} with column+row-parallel FC,
+           composing data parallelism ACROSS processes with tensor
+           parallelism (the reference has no TP at all; SURVEY §2.3).
+
+The task is learnable by construction: a fixed batch whose labels come from
+a fixed random linear teacher, trained repeatedly — so the loss-decrease
+assertion in the parent test is satisfiable (unlike round 1's fresh random
+noise per step).
 """
 
 import os
@@ -23,9 +34,20 @@ if _xb.backends_are_initialized():
 import numpy as np
 
 
+def make_batch(batch=8, dim=8, classes=4, seed=7):
+    """Fixed learnable batch: labels from a fixed linear teacher of x."""
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(batch, dim).astype("float32")
+    teacher = rng.randn(dim, classes).astype("float32")
+    ys = np.argmax(xs @ teacher, axis=1).astype("int64")[:, None]
+    return xs, ys
+
+
 def main():
     pid = int(os.environ["PADDLE_TRAINER_ID"])
     n = int(os.environ["PADDLE_TRAINERS_NUM"])
+    mode = os.environ.get("DIST_MODE", "dp")
+    steps = int(os.environ.get("DIST_STEPS", "5"))
 
     import paddle_tpu as fluid
 
@@ -38,8 +60,13 @@ def main():
     with fluid.program_guard(main_prog, startup):
         x = fluid.layers.data("x", shape=[8])
         y = fluid.layers.data("y", shape=[1], dtype="int64")
-        h = fluid.layers.fc(x, size=16, act="relu")
-        logits = fluid.layers.fc(h, size=4)
+        if mode == "dp_tp":
+            h = fluid.parallel.column_parallel_fc(x, size=16, act="relu")
+            h = fluid.parallel.row_parallel_fc(h, size=16, act="relu")
+            logits = fluid.layers.fc(h, size=4)
+        else:
+            h = fluid.layers.fc(x, size=16, act="relu")
+            logits = fluid.layers.fc(h, size=4)
         loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(logits, y))
         fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
 
@@ -52,12 +79,18 @@ def main():
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
 
-    prog = fluid.CompiledProgram(main_prog).with_data_parallel(loss_name=loss.name)
-    rng = np.random.RandomState(0)  # same global data on every process
+    if mode == "dp_tp":
+        ndev = len(jax.devices())
+        assert ndev % 2 == 0, ndev
+        prog = fluid.CompiledProgram(main_prog).with_mesh(
+            {"data": ndev // 2, "model": 2}, loss_name=loss.name)
+    else:
+        prog = fluid.CompiledProgram(main_prog).with_data_parallel(
+            loss_name=loss.name)
+
+    xs, ys = make_batch()
     losses = []
-    for step in range(5):
-        xs = rng.randn(8, 8).astype("float32")
-        ys = rng.randint(0, 4, (8, 1)).astype("int64")
+    for step in range(steps):
         l, = exe.run(prog, feed={"x": xs, "y": ys}, fetch_list=[loss])
         losses.append(round(float(np.asarray(l)), 6))
     print("DIST_LOSSES:%d:%s" % (pid, ",".join(map(str, losses))), flush=True)

@@ -1,6 +1,6 @@
-"""Multi-process distributed training test without a real cluster
-(reference: test_dist_base.py — 2 trainers as localhost subprocesses,
-dist losses asserted against local losses).
+"""Multi-process distributed training tests without a real cluster
+(reference: test_dist_base.py — trainers as localhost subprocesses,
+dist losses asserted against local losses; check_with_place :216).
 """
 
 import os
@@ -13,12 +13,12 @@ import pytest
 _RUNNER = os.path.join(os.path.dirname(__file__), "dist_runner.py")
 
 
-def _launch(pid, n, port, extra_env=None):
+def _launch(pid, n, port, extra_env=None, local_devices=2):
     env = dict(os.environ)
     env.pop("PYTEST_CURRENT_TEST", None)
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=%d" % local_devices
     env["PADDLE_TRAINER_ID"] = str(pid)
     env["PADDLE_TRAINERS_NUM"] = str(n)
     env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(
@@ -35,24 +35,56 @@ def _losses_from(out: str, pid: int):
     return [float(v) for v in m.group(1).split(",")]
 
 
+def _run_cluster(n, port, extra_env=None, local_devices=2, timeout=300):
+    procs = [_launch(i, n, port, extra_env, local_devices) for i in range(n)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+            assert p.returncode == 0, out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    return [_losses_from(out, i) for i, out in enumerate(outs)]
+
+
 def test_two_process_data_parallel_matches_single():
     # single-process reference run
-    p = _launch(0, 1, 23450)
-    out, _ = p.communicate(timeout=300)
-    assert p.returncode == 0, out
-    single = _losses_from(out, 0)
+    (single,) = _run_cluster(1, 23450)
 
     # two processes over one global mesh (reference: _run_cluster :344)
-    p0 = _launch(0, 2, 23460)
-    p1 = _launch(1, 2, 23460)
-    out0, _ = p0.communicate(timeout=300)
-    out1, _ = p1.communicate(timeout=300)
-    assert p0.returncode == 0, out0
-    assert p1.returncode == 0, out1
-    l0 = _losses_from(out0, 0)
-    l1 = _losses_from(out1, 1)
+    l0, l1 = _run_cluster(2, 23460)
     assert l0 == l1, (l0, l1)  # same replicated loss on both processes
 
     for s, d in zip(single, l0):
         assert abs(s - d) < 1e-4, (single, l0)
-    assert l0[-1] < l0[0]
+    assert l0[-1] < l0[0], l0  # learnable fixed batch => loss must fall
+
+
+def test_two_process_dp_tp_mesh():
+    """dp×tp composed across processes: 2 procs × 2 local devices = a
+    {'data': 2, 'model': 2} global mesh. Losses must be replicated across
+    processes, match the single-process run, and decrease."""
+    env = {"DIST_MODE": "dp_tp"}
+    (single,) = _run_cluster(1, 23470, extra_env=env, local_devices=4)
+
+    l0, l1 = _run_cluster(2, 23480, extra_env=env)
+    assert l0 == l1, (l0, l1)
+    for s, d in zip(single, l0):
+        assert abs(s - d) < 1e-4, (single, l0)
+    assert l0[-1] < l0[0], l0
+
+
+def test_four_process_data_parallel():
+    """4 trainers × 1 local device — the reference's 2-pserver/2-trainer
+    scale, all-collective (NCCL2-mode analog)."""
+    (single,) = _run_cluster(1, 23490, local_devices=4)
+    ls = _run_cluster(4, 23500, local_devices=1)
+    for l in ls[1:]:
+        assert l == ls[0], ls
+    for s, d in zip(single, ls[0]):
+        assert abs(s - d) < 1e-4, (single, ls[0])
+    assert ls[0][-1] < ls[0][0], ls[0]
