@@ -369,6 +369,9 @@ class Program:
         if not for_test:
             p._backward_info = copy.deepcopy(self._backward_info)
             p._lr_var_name = self._lr_var_name
+        # AMP mode survives cloning — an inference clone of an amp-decorated
+        # program must still run its forward in the low-precision dtype.
+        p._amp_dtype = getattr(self, "_amp_dtype", None)
         p._version = self._version
         return p
 

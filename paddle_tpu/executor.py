@@ -114,10 +114,26 @@ class _CompiledStep:
             trace = TraceContext(program, is_test, rng_key, mesh=mesh)
             if bw is None or marker_idx is None:
                 env = dict(state)
-                env.update(_amp_cast_tree(feeds))
+                env.update(feeds)
                 if amp_dtype is not None:
+                    # Cast a COPY of the env for the forward; the fp32 master
+                    # state must survive an eval/fetch run un-degraded. Only
+                    # vars an op actually rewrote (tracer identity changed)
+                    # flow back, cast to their original dtype.
                     env = _amp_cast_tree(env)
-                run_block_ops(ops, env, trace)
+                    before = dict(env)  # hold refs so identity compare is sound
+                    run_block_ops(ops, env, trace)
+                    for k in list(env):
+                        if k not in state:
+                            continue
+                        v = env[k]
+                        if before.get(k) is v:
+                            env[k] = state[k]
+                        elif (hasattr(v, "dtype") and hasattr(state[k], "dtype")
+                              and v.dtype != state[k].dtype):
+                            env[k] = v.astype(state[k].dtype)
+                else:
+                    run_block_ops(ops, env, trace)
             else:
                 loss_name = bw["loss"]
                 param_to_grad = bw["param_to_grad"]

@@ -84,6 +84,12 @@ struct Scanner {
   size_t offset = 0;
   bool corrupt = false;
 
+  // Hard caps so a corrupt/malicious header can't drive unbounded
+  // allocations: 16M records / 1 GiB payload per chunk (writer emits far
+  // smaller chunks; see kDefaultChunk*).
+  static constexpr uint32_t kMaxRecordsPerChunk = 1u << 24;
+  static constexpr uint64_t kMaxPayloadPerChunk = 1ull << 30;
+
   bool load_chunk() {
     uint32_t magic = 0, n = 0, crc = 0;
     uint64_t plen = 0;
@@ -97,8 +103,23 @@ struct Scanner {
       corrupt = true;
       return false;
     }
+    if (n > kMaxRecordsPerChunk || plen > kMaxPayloadPerChunk) {
+      corrupt = true;
+      return false;
+    }
     lens.resize(n);
     if (n && fread(lens.data(), 4, n, f) != n) {
+      lens.clear();
+      corrupt = true;
+      return false;
+    }
+    // The CRC covers the payload only; the record_len table must be
+    // independently consistent or a tampered table would let the scanner
+    // read past payload.data() (heap over-read).
+    uint64_t total = 0;
+    for (uint32_t l : lens) total += l;
+    if (total != plen) {
+      lens.clear();
       corrupt = true;
       return false;
     }
@@ -161,6 +182,10 @@ void* ptrio_scanner_open(const char* path) {
 // NULL at EOF; NULL with *len == UINT64_MAX on corruption.
 const char* ptrio_scanner_next(void* handle, uint64_t* len) {
   auto* s = static_cast<Scanner*>(handle);
+  if (s->corrupt) {  // terminal: never serve records after a corrupt chunk
+    *len = ~0ull;
+    return nullptr;
+  }
   if (s->rec_idx >= s->lens.size()) {
     if (!s->load_chunk()) {
       *len = s->corrupt ? ~0ull : 0ull;

@@ -82,7 +82,12 @@ class Scanner:
             self._h = None
 
 
-def write_records(path: str, examples, serializer=pickle.dumps):
+def write_records(path: str, examples, serializer=None):
+    """Write records. By default records must already be ``bytes`` (the
+    reference recordio stores raw byte records); pass
+    ``serializer=pickle.dumps`` to store arbitrary objects."""
+    if serializer is None:
+        serializer = _require_bytes
     with Writer(path) as w:
         n = 0
         for e in examples:
@@ -91,18 +96,31 @@ def write_records(path: str, examples, serializer=pickle.dumps):
     return n
 
 
-def read_records(path: str, deserializer=pickle.loads):
+def _require_bytes(e):
+    if not isinstance(e, (bytes, bytearray)):
+        raise TypeError(
+            "recordio stores bytes; got %s — pass serializer=pickle.dumps "
+            "to store arbitrary objects" % type(e).__name__)
+    return bytes(e)
+
+
+def read_records(path: str, deserializer=None):
+    """Yield records as raw ``bytes`` by default. Deserializing with pickle
+    executes arbitrary code from the file, so it is strictly opt-in
+    (``deserializer=pickle.loads``) for files you trust."""
     s = Scanner(path)
     try:
         for rec in s:
-            yield deserializer(rec)
+            yield deserializer(rec) if deserializer is not None else rec
     finally:
         s.close()
 
 
-def recordio_reader(path: str, deserializer=pickle.loads):
+def recordio_reader(path: str, deserializer=None):
     """A reader() factory over a recordio file — plugs into the decorator
-    pipeline (batch/shuffle/...) like the reference's recordio reader op."""
+    pipeline (batch/shuffle/...) like the reference's recordio reader op.
+    Yields raw bytes unless an explicit ``deserializer`` is given (see
+    ``read_records`` for the pickle trust caveat)."""
 
     def reader():
         return read_records(path, deserializer)

@@ -63,6 +63,30 @@ def test_amp_loss_close_to_fp32(rng):
     np.testing.assert_allclose(fp32, bf16, rtol=0.05, atol=0.02)
 
 
+def test_amp_eval_does_not_degrade_fp32_state(rng):
+    """A forward-only (eval/fetch) run under AMP must not write bf16 copies
+    of params or BN stats back into the scope (ADVICE r1: executor.py:119)."""
+    with fluid.scope_guard(fluid.Scope()):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            h, logits, loss = _build()
+            fluid.amp.decorate(fluid.optimizer.Adam(1e-2)).minimize(loss)
+        infer = main.clone(for_test=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        w_name = [p.name for p in main.all_parameters() if p.name.endswith("w_0")][0]
+        before = np.asarray(fluid.global_scope().find_var(w_name))
+        assert before.dtype == np.float32
+        xs = rng.randn(8, 16).astype("float32")
+        ys = rng.randint(0, 4, (8, 1)).astype("int64")
+        # eval-only run (no backward): fetch logits from the cloned program
+        exe.run(infer, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        after_var = fluid.global_scope().find_var(w_name)
+        after = np.asarray(after_var)
+        assert after.dtype == np.float32, "fp32 master degraded to %s" % after.dtype
+        np.testing.assert_array_equal(before, after)
+
+
 def test_amp_static_loss_scaling_matches_unscaled(rng):
     xs = rng.randn(32, 16).astype("float32")
     ys = rng.randint(0, 4, (32, 1)).astype("int64")

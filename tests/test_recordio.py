@@ -29,16 +29,28 @@ def test_roundtrip_many_chunks(tmp_path):
 
 
 def test_pickle_examples_and_reader_pipeline(tmp_path, rng):
+    import pickle
+
     from paddle_tpu import reader as R
 
     path = str(tmp_path / "examples.rio")
     examples = [(rng.randn(4).astype("float32"), int(i % 3)) for i in range(100)]
-    n = recordio.write_records(path, examples)
+    # pickle is opt-in: structured objects need an explicit serializer
+    with pytest.raises(TypeError):
+        recordio.write_records(path, examples)
+    n = recordio.write_records(path, examples, serializer=pickle.dumps)
     assert n == 100
-    r = recordio.recordio_reader(path)
+    r = recordio.recordio_reader(path, deserializer=pickle.loads)
     batches = list(R.batch(r, 32)())
     assert len(batches) == 4 and len(batches[0]) == 32
     np.testing.assert_array_equal(batches[0][0][0], examples[0][0])
+
+
+def test_raw_bytes_default(tmp_path):
+    path = str(tmp_path / "raw.rio")
+    recs = [b"a", b"bb", b"ccc"]
+    assert recordio.write_records(path, recs) == 3
+    assert list(recordio.read_records(path)) == recs
 
 
 def test_corruption_detected(tmp_path):
@@ -48,6 +60,25 @@ def test_corruption_detected(tmp_path):
             w.write(b"payload-%d" % i)
     data = bytearray(open(path, "rb").read())
     data[len(data) // 2] ^= 0xFF  # flip a payload bit
+    open(path, "wb").write(bytes(data))
+    with pytest.raises(recordio.RecordIOCorruptError):
+        list(recordio.Scanner(path))
+
+
+def test_tampered_len_table_detected(tmp_path):
+    """The CRC covers the payload only; an inflated record_len entry must
+    still be rejected (sum(lens) != payload_len) instead of reading past the
+    payload buffer."""
+    path = str(tmp_path / "tamper.rio")
+    with recordio.Writer(path) as w:
+        for i in range(4):
+            w.write(b"record-%d" % i)
+    data = bytearray(open(path, "rb").read())
+    # layout: magic(4) n(4) plen(8) crc(4) lens(4*n) payload — inflate lens[0]
+    import struct
+
+    (l0,) = struct.unpack_from("<I", data, 20)
+    struct.pack_into("<I", data, 20, l0 + 1000)
     open(path, "wb").write(bytes(data))
     with pytest.raises(recordio.RecordIOCorruptError):
         list(recordio.Scanner(path))
