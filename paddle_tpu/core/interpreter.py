@@ -24,3 +24,30 @@ def run_block_ops(ops, env: Dict[str, Any], trace, offset: int = 0):
         trace.current_op_idx = offset + i
         impl = get_op_impl(op.type)
         impl(OpContext(op, env, trace))
+
+
+class PerStepTrace:
+    """Trace proxy for loop bodies (lax.scan/while): folds the (traced) step
+    index into every op's PRNG key so stochastic ops (dropout etc.) draw a
+    fresh mask per timestep instead of reusing the trace-time constant."""
+
+    def __init__(self, inner, step_index):
+        self._inner = inner
+        self._step_index = step_index
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    # current_op_idx is written by run_block_ops — forward to the inner trace
+    @property
+    def current_op_idx(self):
+        return self._inner.current_op_idx
+
+    @current_op_idx.setter
+    def current_op_idx(self, v):
+        self._inner.current_op_idx = v
+
+    def op_rng(self, ctx):
+        import jax
+
+        return jax.random.fold_in(self._inner.op_rng(ctx), self._step_index)

@@ -1,6 +1,8 @@
-from . import attention, control_flow, io, learning_rate_scheduler, nn, sequence, tensor  # noqa: F401
+from . import attention, control_flow, io, learning_rate_scheduler, nn, rnn, sequence, tensor  # noqa: F401
 from .attention import multi_head_attention, scaled_dot_product_attention  # noqa: F401
+from .rnn import dynamic_lstm, dynamic_lstmp, dynamic_gru, lstm, lstm_unit, gru_unit  # noqa: F401
 from .control_flow import (  # noqa: F401
+    DynamicRNN,
     StaticRNN,
     While,
     cond,

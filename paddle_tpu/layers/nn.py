@@ -552,7 +552,11 @@ def cross_entropy(input, label, soft_label=False, ignore_index=-100):
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
-                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+                               numeric_stable_mode=True, return_softmax=False, axis=-1,
+                               label_smoothing=0.0):
+    """``label_smoothing`` is a TPU-native fusion extension: smoothing folds
+    into the single log_softmax pass instead of a second full-vocab traversal
+    (the reference composes label_smooth + softmax_with_cross_entropy ops)."""
     helper = LayerHelper("softmax_with_cross_entropy")
     softmax_out = helper.create_variable_for_type_inference(logits.dtype)
     loss = helper.create_variable_for_type_inference(logits.dtype)
@@ -560,7 +564,8 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-10
         "softmax_with_cross_entropy",
         inputs={"Logits": logits, "Label": label},
         outputs={"Softmax": softmax_out, "Loss": loss},
-        attrs={"soft_label": soft_label, "ignore_index": ignore_index},
+        attrs={"soft_label": soft_label, "ignore_index": ignore_index,
+               "label_smoothing": float(label_smoothing)},
     )
     if return_softmax:
         return loss, softmax_out

@@ -152,19 +152,11 @@ def transformer(
 
     logits = layers.fc(dec_out, size=trg_vocab_size, num_flatten_dims=2,
                        name="predict")
-    if label_smooth_eps and not is_test:
-        # -(q · log p) with q = (1-eps)·onehot + eps/K, computed WITHOUT
-        # materializing the [B, S, V] one-hot (HBM-bandwidth killer):
-        # (1-eps)·CE(label) + eps/K · Σ(-log p)
-        ce = layers.softmax_with_cross_entropy(logits, trg_labels)
-        neg_logsum = tl.scale(
-            layers.reduce_sum(layers.log_softmax(logits), dim=-1, keep_dim=True),
-            scale=-1.0)
-        per_tok = layers.elementwise_add(
-            tl.scale(ce, scale=1.0 - label_smooth_eps),
-            tl.scale(neg_logsum, scale=label_smooth_eps / trg_vocab_size))
-    else:
-        per_tok = layers.softmax_with_cross_entropy(logits, trg_labels)
+    # label smoothing fused into the single log_softmax pass — the [B, S, V]
+    # logits array is the HBM-bandwidth hot spot, traverse it once.
+    per_tok = layers.softmax_with_cross_entropy(
+        logits, trg_labels,
+        label_smoothing=(label_smooth_eps or 0.0) if not is_test else 0.0)
     # mask out padding positions; normalize by token count
     masked = layers.elementwise_mul(per_tok, layers.unsqueeze(trg_mask, axes=[2]))
     token_count = layers.reduce_sum(trg_mask)

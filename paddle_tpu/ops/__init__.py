@@ -9,6 +9,7 @@ from . import (  # noqa: F401
     nn_ops,
     optimizer_ops,
     reduce_ops,
+    rnn_ops,
     sequence_ops,
     tensor_ops,
 )

@@ -98,17 +98,22 @@ def recurrent_op(ctx: OpContext):
 
     xs = {inner: env[outer] for outer, inner in step_inputs}
     init = {prev: env[init_name] for prev, _, init_name in memories}
+    seq_len = env[step_inputs[0][0]].shape[0] if step_inputs else 0
 
-    def body(carry, x_t):
+    def body(carry, inp):
+        x_t, t_idx = inp
         local = dict(env)
         local.update(x_t)
         local.update(carry)
-        run_block_ops(block.ops, local, ctx.trace, offset=10_000 * block.idx)
+        from ..core.interpreter import PerStepTrace
+
+        run_block_ops(block.ops, local, PerStepTrace(ctx.trace, t_idx),
+                      offset=10_000 * block.idx)
         new_carry = {prev: local[updated] for prev, updated, _ in memories}
         ys = tuple(local[n] for n in step_outputs)
         return new_carry, ys
 
-    final_carry, ys = jax.lax.scan(body, init, xs)
+    final_carry, ys = jax.lax.scan(body, init, (xs, jnp.arange(seq_len)))
     ctx.set_outputs("Out", list(ys))
     for n, v in zip(ctx.output_names("Out"), ys):
         env[n] = v

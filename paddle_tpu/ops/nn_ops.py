@@ -54,20 +54,33 @@ def cross_entropy_op(ctx: OpContext):
 
 @register_op("softmax_with_cross_entropy")
 def softmax_with_cross_entropy_op(ctx: OpContext):
+    """One log_softmax pass serves plain CE, soft labels, AND label
+    smoothing (``label_smoothing`` attr) — with a wide vocab the logits array
+    dominates HBM traffic, so everything is derived from a single read. The
+    softmax itself runs in fp32 even under bf16 AMP (logsumexp over 30k
+    classes is precision-critical)."""
     logits = ctx.input("Logits")
     label = ctx.input("Label")
     soft_label = ctx.attr("soft_label", False)
-    log_p = jax.nn.log_softmax(logits, axis=-1)
+    smooth = float(ctx.attr("label_smoothing", 0.0) or 0.0)
+    out_dtype = logits.dtype
+    log_p = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     if soft_label:
         loss = -jnp.sum(label * log_p, axis=-1, keepdims=True)
     else:
         lbl = label.reshape(label.shape[:-1]) if label.shape[-1] == 1 else label
         lbl = lbl.astype(jnp.int32)
         picked = jnp.take_along_axis(log_p, jnp.maximum(lbl, 0)[..., None], axis=-1)
+        loss = -picked
+        if smooth:
+            # q = (1-eps)·onehot + eps/K  ⇒  CE = (1-eps)·nll + eps/K·Σ(-logp)
+            k = logits.shape[-1]
+            loss = (1.0 - smooth) * loss + (smooth / k) * (
+                -jnp.sum(log_p, axis=-1, keepdims=True))
         ignore = ctx.attr("ignore_index", -100)
-        loss = jnp.where((lbl != ignore)[..., None], -picked, jnp.zeros_like(picked))
-    ctx.set_output("Softmax", jnp.exp(log_p))
-    ctx.set_output("Loss", loss)
+        loss = jnp.where((lbl != ignore)[..., None], loss, jnp.zeros_like(loss))
+    ctx.set_output("Softmax", jnp.exp(log_p).astype(out_dtype))
+    ctx.set_output("Loss", loss.astype(out_dtype))
 
 
 @register_op("sigmoid_cross_entropy_with_logits")
@@ -314,6 +327,9 @@ def _conv_nd(ctx: OpContext, nd: int, transpose: bool = False):
     rhs_spec = "OI" + spatial
     dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, (lhs_spec, rhs_spec, lhs_spec))
     if not transpose:
+        # No preferred_element_type widening: the TPU MXU already accumulates
+        # bf16 convs in fp32 internally, and the f32 hint breaks jax.grad
+        # (the transpose conv then mixes a f32 cotangent with bf16 operands).
         out = jax.lax.conv_general_dilated(
             x,
             w,
@@ -322,10 +338,7 @@ def _conv_nd(ctx: OpContext, nd: int, transpose: bool = False):
             rhs_dilation=dilations,
             dimension_numbers=dn,
             feature_group_count=groups,
-            preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
         )
-        if out.dtype != x.dtype:
-            out = out.astype(x.dtype)
     else:
         # conv_transpose: fluid filter layout is [in_c, out_c/g, H, W]
         w_t = jnp.swapaxes(w, 0, 1)  # → [out_c/g, in_c, H, W]

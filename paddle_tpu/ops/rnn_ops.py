@@ -1,0 +1,411 @@
+"""Recurrent ops: dynamic_lstm / dynamic_gru / stacked lstm / unit steps.
+
+Reference: ``operators/lstm_op.cc``, ``operators/gru_op.cc``,
+``operators/lstm_unit_op.cc``, ``operators/gru_unit_op.cc``,
+``operators/cudnn_lstm_op.cu.cc`` and the shared compute kernels in
+``operators/math/lstm_compute.cc`` / ``gru_compute.cc``.
+
+TPU-native redesign: Fluid's LoD-packed sequences + per-timestep CPU/CUDA
+kernels become one ``lax.scan`` over a padded batch-major tensor with a
+``Length`` vector (the repo-wide padded+Length replacement for LoD, see
+ops/sequence_ops.py). Each scan step is a fused matmul+gates block that XLA
+maps onto the MXU; masking freezes carried state past each row's length and
+zeroes padded outputs, which reproduces the variable-length semantics
+bit-for-bit without ragged tensors or host loops.
+
+Gate layout convention (documented, self-consistent with the layer API and
+tests): the 4H projection splits as [i, f, c̃, o]; GRU's 3H splits as
+[u, r, c̃] (update, reset, candidate).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import OpContext, register_op
+
+_ACT = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+    "hard_sigmoid": jax.nn.hard_sigmoid,
+    "softsign": jax.nn.soft_sign,
+}
+
+
+def _act(name, default):
+    return _ACT[name or default]
+
+
+def _length_mask(length, batch, maxlen, dtype):
+    """[B, T] 1.0 where t < length_b (all-ones when Length is absent)."""
+    from .sequence_ops import _mask
+
+    if length is None:
+        return jnp.ones((batch, maxlen), dtype)
+    return _mask(length, maxlen, dtype)
+
+
+def _masked_scan(step, inits, xs_tm, mask_tm):
+    """scan ``step`` over time-major xs; freeze carries and zero outputs on
+    masked-out steps. step: (carries, x_t) -> (new_carries, outs_t)."""
+
+    def body(carries, inp):
+        x_t, m_t = inp
+        new_carries, outs = step(carries, x_t)
+        m = m_t[:, None]
+        new_carries = tuple(
+            m * nc + (1.0 - m) * c for nc, c in zip(new_carries, carries))
+        outs = tuple(m * o for o in outs)
+        return new_carries, outs
+
+    return jax.lax.scan(body, inits, (xs_tm, mask_tm))
+
+
+@register_op("dynamic_lstm")
+def dynamic_lstm_op(ctx: OpContext):
+    """Input [B,T,4H] (x-projection precomputed by an fc, as in the
+    reference), Weight [H,4H] recurrent weights, Bias [1,4H] (or [1,7H] with
+    peepholes: extra W_ic, W_fc, W_oc diagonals). Outputs Hidden/Cell [B,T,H].
+    Reference: operators/lstm_op.cc, math/lstm_compute.cc."""
+    x = ctx.input("Input")
+    w = ctx.input("Weight")
+    bias = ctx.input("Bias")
+    h0 = ctx.input("H0")
+    c0 = ctx.input("C0")
+    length = ctx.input("Length")
+    hidden = w.shape[0]
+    use_peepholes = bool(ctx.attr("use_peepholes", False))
+    is_reverse = bool(ctx.attr("is_reverse", False))
+    gate_act = _act(ctx.attr("gate_activation"), "sigmoid")
+    cell_act = _act(ctx.attr("cell_activation"), "tanh")
+    cand_act = _act(ctx.attr("candidate_activation"), "tanh")
+
+    batch, maxlen = x.shape[0], x.shape[1]
+    dt = x.dtype
+    if bias is not None:
+        b_gate = bias.reshape(-1)[: 4 * hidden]
+        x = x + b_gate
+        if use_peepholes:
+            peep = bias.reshape(-1)[4 * hidden : 7 * hidden]
+            w_ic, w_fc, w_oc = jnp.split(peep, 3)
+        else:
+            w_ic = w_fc = w_oc = None
+    else:
+        w_ic = w_fc = w_oc = None
+
+    mask = _length_mask(length, batch, maxlen, dt)
+    if is_reverse:
+        x = jnp.flip(x, axis=1)
+        mask = jnp.flip(mask, axis=1)
+
+    xs_tm = jnp.swapaxes(x, 0, 1)  # [T,B,4H]
+    mask_tm = jnp.swapaxes(mask, 0, 1)  # [T,B]
+    h_init = h0 if h0 is not None else jnp.zeros((batch, hidden), dt)
+    c_init = c0 if c0 is not None else jnp.zeros((batch, hidden), dt)
+
+    def step(carries, x_t):
+        h_prev, c_prev = carries
+        gates = x_t + h_prev @ w
+        gi, gf, gc, go = jnp.split(gates, 4, axis=1)
+        if use_peepholes:
+            gi = gi + c_prev * w_ic
+            gf = gf + c_prev * w_fc
+        i = gate_act(gi)
+        f = gate_act(gf)
+        c = f * c_prev + i * cand_act(gc)
+        if use_peepholes:
+            go = go + c * w_oc
+        o = gate_act(go)
+        h = o * cell_act(c)
+        return (h, c), (h, c)
+
+    (_, _), (hs, cs) = _masked_scan(step, (h_init, c_init), xs_tm, mask_tm)
+    hs = jnp.swapaxes(hs, 0, 1)
+    cs = jnp.swapaxes(cs, 0, 1)
+    if is_reverse:
+        hs = jnp.flip(hs, axis=1)
+        cs = jnp.flip(cs, axis=1)
+    ctx.set_output("Hidden", hs)
+    ctx.set_output("Cell", cs)
+
+
+@register_op("dynamic_lstmp")
+def dynamic_lstmp_op(ctx: OpContext):
+    """LSTM with a recurrent projection layer (reference: lstmp_op.cc):
+    Weight is [P,4H] over the projected state r = proj_act(h @ ProjWeight),
+    ProjWeight [H,P]. Outputs Projection [B,T,P] and Cell [B,T,H]."""
+    x = ctx.input("Input")
+    w = ctx.input("Weight")  # [P, 4H]
+    w_proj = ctx.input("ProjWeight")  # [H, P]
+    bias = ctx.input("Bias")
+    length = ctx.input("Length")
+    hidden = w_proj.shape[0]
+    proj = w_proj.shape[1]
+    is_reverse = bool(ctx.attr("is_reverse", False))
+    gate_act = _act(ctx.attr("gate_activation"), "sigmoid")
+    cell_act = _act(ctx.attr("cell_activation"), "tanh")
+    cand_act = _act(ctx.attr("candidate_activation"), "tanh")
+    proj_act = _act(ctx.attr("proj_activation"), "tanh")
+
+    batch, maxlen = x.shape[0], x.shape[1]
+    dt = x.dtype
+    if bias is not None:
+        x = x + bias.reshape(-1)[: 4 * hidden]
+    mask = _length_mask(length, batch, maxlen, dt)
+    if is_reverse:
+        x = jnp.flip(x, axis=1)
+        mask = jnp.flip(mask, axis=1)
+    xs_tm = jnp.swapaxes(x, 0, 1)
+    mask_tm = jnp.swapaxes(mask, 0, 1)
+    r_init = jnp.zeros((batch, proj), dt)
+    c_init = jnp.zeros((batch, hidden), dt)
+
+    def step(carries, x_t):
+        r_prev, c_prev = carries
+        gates = x_t + r_prev @ w
+        gi, gf, gc, go = jnp.split(gates, 4, axis=1)
+        i, f, o = gate_act(gi), gate_act(gf), gate_act(go)
+        c = f * c_prev + i * cand_act(gc)
+        h = o * cell_act(c)
+        r = proj_act(h @ w_proj)
+        return (r, c), (r, c)
+
+    (_, _), (rs, cs) = _masked_scan(step, (r_init, c_init), xs_tm, mask_tm)
+    rs = jnp.swapaxes(rs, 0, 1)
+    cs = jnp.swapaxes(cs, 0, 1)
+    if is_reverse:
+        rs = jnp.flip(rs, axis=1)
+        cs = jnp.flip(cs, axis=1)
+    ctx.set_output("Projection", rs)
+    ctx.set_output("Cell", cs)
+
+
+def _gru_step(w, hidden, gate_act, cand_act, origin_mode):
+    w_ur = w[:, : 2 * hidden]  # [H, 2H] update+reset
+    w_c = w[:, 2 * hidden :]  # [H, H] candidate
+
+    def step(carries, x_t):
+        (h_prev,) = carries
+        xur = x_t[:, : 2 * hidden]
+        xc = x_t[:, 2 * hidden :]
+        ur = gate_act(xur + h_prev @ w_ur)
+        u, r = jnp.split(ur, 2, axis=1)
+        c = cand_act(xc + (r * h_prev) @ w_c)
+        if origin_mode:
+            h = (1.0 - u) * c + u * h_prev
+        else:
+            h = u * c + (1.0 - u) * h_prev
+        return (h,), (h,)
+
+    return step
+
+
+@register_op("dynamic_gru")
+def dynamic_gru_op(ctx: OpContext):
+    """Input [B,T,3H] (x-projection precomputed), Weight [H,3H] split
+    [u|r|c̃], Bias [1,3H]. Output Hidden [B,T,H].
+    Reference: operators/gru_op.cc, math/gru_compute.cc."""
+    x = ctx.input("Input")
+    w = ctx.input("Weight")
+    bias = ctx.input("Bias")
+    h0 = ctx.input("H0")
+    length = ctx.input("Length")
+    hidden = w.shape[0]
+    is_reverse = bool(ctx.attr("is_reverse", False))
+    origin_mode = bool(ctx.attr("origin_mode", False))
+    gate_act = _act(ctx.attr("gate_activation"), "sigmoid")
+    cand_act = _act(ctx.attr("candidate_activation"), "tanh")
+
+    batch, maxlen = x.shape[0], x.shape[1]
+    dt = x.dtype
+    if bias is not None:
+        x = x + bias.reshape(-1)
+    mask = _length_mask(length, batch, maxlen, dt)
+    if is_reverse:
+        x = jnp.flip(x, axis=1)
+        mask = jnp.flip(mask, axis=1)
+    xs_tm = jnp.swapaxes(x, 0, 1)
+    mask_tm = jnp.swapaxes(mask, 0, 1)
+    h_init = h0 if h0 is not None else jnp.zeros((batch, hidden), dt)
+
+    step = _gru_step(w, hidden, gate_act, cand_act, origin_mode)
+    (_,), (hs,) = _masked_scan(step, (h_init,), xs_tm, mask_tm)
+    hs = jnp.swapaxes(hs, 0, 1)
+    if is_reverse:
+        hs = jnp.flip(hs, axis=1)
+    ctx.set_output("Hidden", hs)
+
+
+@register_op("lstm")
+def lstm_op(ctx: OpContext):
+    """Stacked (optionally bidirectional) LSTM over raw features — the
+    cudnn_lstm analog (reference: operators/cudnn_lstm_op.cu.cc). Inputs:
+    Input [B,T,D], InitH/InitC [L*dirs,B,H], WeightX (per layer*dir,
+    [D_l,4H]), WeightH ([H,4H]), Bias ([4H]). Outputs Out [B,T,H*dirs],
+    LastH/LastC [L*dirs,B,H]."""
+    x = ctx.input("Input")
+    init_h = ctx.input("InitH")
+    init_c = ctx.input("InitC")
+    length = ctx.input("Length")
+    wx_list = ctx.inputs("WeightX")
+    wh_list = ctx.inputs("WeightH")
+    b_list = ctx.inputs("Bias")
+    num_layers = int(ctx.attr("num_layers", 1))
+    is_bidirec = bool(ctx.attr("is_bidirec", False))
+    dropout_prob = float(ctx.attr("dropout_prob", 0.0) or 0.0)
+    dirs = 2 if is_bidirec else 1
+    hidden = wh_list[0].shape[0]
+    batch, maxlen = x.shape[0], x.shape[1]
+    dt = x.dtype
+
+    mask = _length_mask(length, batch, maxlen, dt)
+    mask_tm = jnp.swapaxes(mask, 0, 1)
+
+    def run_dir(inp, wx, wh, b, h0, c0, reverse):
+        seq = jnp.flip(inp, axis=1) if reverse else inp
+        m_tm = jnp.flip(mask_tm, axis=0) if reverse else mask_tm
+        xs = jnp.swapaxes(seq @ wx + b, 0, 1)
+
+        def step(carries, x_t):
+            h_prev, c_prev = carries
+            gates = x_t + h_prev @ wh
+            gi, gf, gc, go = jnp.split(gates, 4, axis=1)
+            i, f, o = jax.nn.sigmoid(gi), jax.nn.sigmoid(gf), jax.nn.sigmoid(go)
+            c = f * c_prev + i * jnp.tanh(gc)
+            h = o * jnp.tanh(c)
+            return (h, c), (h,)
+
+        (h_last, c_last), (hs,) = _masked_scan(step, (h0, c0), xs, m_tm)
+        hs = jnp.swapaxes(hs, 0, 1)
+        if reverse:
+            hs = jnp.flip(hs, axis=1)
+        return hs, h_last, c_last
+
+    out = x
+    last_hs, last_cs = [], []
+    for layer in range(num_layers):
+        layer_outs = []
+        for d in range(dirs):
+            idx = layer * dirs + d
+            h0 = init_h[idx] if init_h is not None else jnp.zeros((batch, hidden), dt)
+            c0 = init_c[idx] if init_c is not None else jnp.zeros((batch, hidden), dt)
+            hs, h_last, c_last = run_dir(
+                out, wx_list[idx], wh_list[idx], b_list[idx], h0, c0, d == 1)
+            layer_outs.append(hs)
+            last_hs.append(h_last)
+            last_cs.append(c_last)
+        out = jnp.concatenate(layer_outs, axis=-1) if dirs > 1 else layer_outs[0]
+        if dropout_prob and not ctx.is_test and layer < num_layers - 1:
+            key = jax.random.fold_in(ctx.rng(), layer)  # distinct mask per layer
+            keep = jax.random.bernoulli(key, 1.0 - dropout_prob, out.shape)
+            out = jnp.where(keep, out / (1.0 - dropout_prob), 0).astype(out.dtype)
+    ctx.set_output("Out", out * mask[:, :, None])
+    ctx.set_output("LastH", jnp.stack(last_hs))
+    ctx.set_output("LastC", jnp.stack(last_cs))
+
+
+@register_op("gru_unit")
+def gru_unit_op(ctx: OpContext):
+    """One GRU step (reference: operators/gru_unit_op.cc): Input [B,3H]
+    (x-projection), HiddenPrev [B,H], Weight [H,3H], Bias [1,3H]."""
+    x = ctx.input("Input")
+    h_prev = ctx.input("HiddenPrev")
+    w = ctx.input("Weight")
+    bias = ctx.input("Bias")
+    hidden = w.shape[0]
+    origin_mode = bool(ctx.attr("origin_mode", False))
+    gate_act = _act(ctx.attr("gate_activation"), "sigmoid")
+    cand_act = _act(ctx.attr("candidate_activation"), "tanh")
+    if bias is not None:
+        x = x + bias.reshape(-1)
+    step = _gru_step(w, hidden, gate_act, cand_act, origin_mode)
+    (h,), (_,) = step((h_prev,), x)
+    ctx.set_output("Hidden", h)
+
+
+@register_op("lstm_unit")
+def lstm_unit_op(ctx: OpContext):
+    """One LSTM step on pre-projected gates (reference:
+    operators/lstm_unit_op.cc): X [B,4H] = [i|f|c̃|o], C_prev [B,H];
+    forget_bias added to f before the sigmoid."""
+    x = ctx.input("X")
+    c_prev = ctx.input("C_prev")
+    forget_bias = float(ctx.attr("forget_bias", 0.0) or 0.0)
+    gi, gf, gc, go = jnp.split(x, 4, axis=1)
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf + forget_bias)
+    c = f * c_prev + i * jnp.tanh(gc)
+    h = jax.nn.sigmoid(go) * jnp.tanh(c)
+    ctx.set_output("C", c)
+    ctx.set_output("H", h)
+
+
+@register_op("dynamic_rnn")
+def dynamic_rnn_op(ctx: OpContext):
+    """DynamicRNN execution (reference: the LoD-bucketed DynamicRNN,
+    layers/control_flow.py:1394 + lod_rank_table/shrink_rnn_memory ops).
+
+    Fluid sorts sequences by length and shrinks the batch as sequences end;
+    on TPU that dynamic re-batching would defeat XLA's static shapes, so the
+    redesign scans the full padded batch and masks: carried memories freeze
+    and outputs are zeroed once t ≥ length_b — same results, constant shape.
+
+    attrs: sub_block, step_inputs [(outer,inner)], static_inputs
+    [(outer,inner)], memories [(prev,updated,init_outer)], step_outputs;
+    inputs: X (outer step inputs, batch-major [B,T,...]), Length [B],
+    Boot (memory inits); outputs Out (stacked [B,T,...]).
+    """
+    block = ctx.trace.program.blocks[ctx.attr("sub_block")]
+    step_inputs = ctx.attr("step_inputs")
+    static_inputs = ctx.attr("static_inputs", []) or []
+    memories = ctx.attr("memories")
+    step_outputs = ctx.attr("step_outputs")
+    env = ctx.env
+    length = ctx.input("Length")
+
+    first = env[step_inputs[0][0]]
+    batch, maxlen = first.shape[0], first.shape[1]
+    mask_tm = jnp.swapaxes(
+        _length_mask(length, batch, maxlen, jnp.float32), 0, 1)
+
+    xs = {inner: jnp.swapaxes(env[outer], 0, 1) for outer, inner in step_inputs}
+    statics = {inner: env[outer] for outer, inner in static_inputs}
+    init = {prev: env[init_name] for prev, _, init_name in memories}
+
+    def body(carry, inp):
+        x_t, m_t, t_idx = inp
+        local = dict(env)
+        local.update(statics)
+        local.update(x_t)
+        local.update(carry)
+        from ..core.interpreter import PerStepTrace
+
+        run_block_ops_ref(block.ops, local, PerStepTrace(ctx.trace, t_idx),
+                          offset=10_000 * block.idx)
+        new_carry = {}
+        for prev, updated, _ in memories:
+            m = m_t.reshape((-1,) + (1,) * (local[updated].ndim - 1))
+            new_carry[prev] = (m * local[updated]
+                               + (1.0 - m) * carry[prev]).astype(carry[prev].dtype)
+        ys = tuple(
+            (local[n] * m_t.reshape((-1,) + (1,) * (local[n].ndim - 1))
+             ).astype(local[n].dtype)
+            for n in step_outputs)
+        return new_carry, ys
+
+    final_carry, ys = jax.lax.scan(
+        body, init, (xs, mask_tm, jnp.arange(maxlen)))
+    outs = [jnp.swapaxes(y, 0, 1) for y in ys]
+    for n, v in zip(ctx.output_names("Out"), outs):
+        env[n] = v
+    for (prev, updated, _), name in zip(memories, ctx.output_names("FinalStates")):
+        env[name] = final_carry[prev]
+
+
+def run_block_ops_ref(*args, **kw):
+    from ..core.interpreter import run_block_ops
+
+    return run_block_ops(*args, **kw)

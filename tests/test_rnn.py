@@ -1,0 +1,304 @@
+"""RNN family tests: dynamic_lstm/dynamic_gru vs numpy references,
+unit-step ops, stacked lstm, DynamicRNN, and the two reference book
+workloads these ops gate (label_semantic_roles- and machine_translation-
+style models training to decreasing loss).
+
+Reference test model: python/paddle/fluid/tests/unittests/test_lstm_op.py,
+test_gru_op.py, test_dynrnn_*.py, tests/book/test_label_semantic_roles.py,
+tests/book/test_machine_translation.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def np_lstm(x, w, b, length, hidden, peephole=False):
+    """Numpy reference: gate order [i, f, c̃, o], padded+length semantics."""
+    B, T, _ = x.shape
+    h = np.zeros((B, hidden), "float64")
+    c = np.zeros((B, hidden), "float64")
+    hs = np.zeros((B, T, hidden), "float64")
+    cs = np.zeros((B, T, hidden), "float64")
+    gate_b = b[: 4 * hidden]
+    if peephole:
+        w_ic, w_fc, w_oc = np.split(b[4 * hidden:], 3)
+    for t in range(T):
+        gates = x[:, t] + gate_b + h @ w
+        gi, gf, gc, go = np.split(gates, 4, axis=1)
+        if peephole:
+            gi = gi + c * w_ic
+            gf = gf + c * w_fc
+        i, f = _sigmoid(gi), _sigmoid(gf)
+        c_new = f * c + i * np.tanh(gc)
+        if peephole:
+            go = go + c_new * w_oc
+        o = _sigmoid(go)
+        h_new = o * np.tanh(c_new)
+        alive = (t < length)[:, None]
+        h = np.where(alive, h_new, h)
+        c = np.where(alive, c_new, c)
+        hs[:, t] = np.where(alive, h_new, 0.0)
+        cs[:, t] = np.where(alive, c_new, 0.0)
+    return hs, cs
+
+
+def np_gru(x, w, b, length, hidden, origin_mode=False):
+    B, T, _ = x.shape
+    h = np.zeros((B, hidden), "float64")
+    hs = np.zeros((B, T, hidden), "float64")
+    w_ur, w_c = w[:, : 2 * hidden], w[:, 2 * hidden:]
+    for t in range(T):
+        xt = x[:, t] + b
+        ur = _sigmoid(xt[:, : 2 * hidden] + h @ w_ur)
+        u, r = np.split(ur, 2, axis=1)
+        cand = np.tanh(xt[:, 2 * hidden:] + (r * h) @ w_c)
+        h_new = (1 - u) * cand + u * h if origin_mode else u * cand + (1 - u) * h
+        alive = (t < length)[:, None]
+        h = np.where(alive, h_new, h)
+        hs[:, t] = np.where(alive, h_new, 0.0)
+    return hs
+
+
+@pytest.mark.parametrize("peephole", [False, True])
+def test_dynamic_lstm_matches_numpy(rng, peephole):
+    B, T, H = 4, 6, 8
+    x_np = rng.randn(B, T, 4 * H).astype("float32") * 0.5
+    length_np = np.array([6, 3, 5, 1], "int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[T, 4 * H])
+        length = fluid.layers.data("length", shape=[], dtype="int64")
+        h, c = fluid.layers.dynamic_lstm(x, size=4 * H, length=length,
+                                         use_peepholes=peephole)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        hv, cv = exe.run(main, feed={"x": x_np, "length": length_np},
+                         fetch_list=[h, c])
+        w = np.asarray(fluid.global_scope().find_var(
+            [p.name for p in main.all_parameters() if ".w" in p.name][0]))
+        b = np.asarray(fluid.global_scope().find_var(
+            [p.name for p in main.all_parameters() if ".b" in p.name][0])).reshape(-1)
+    ref_h, ref_c = np_lstm(x_np.astype("float64"), w.astype("float64"),
+                           b.astype("float64"), length_np, H, peephole)
+    np.testing.assert_allclose(hv, ref_h, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(cv, ref_c, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("origin_mode", [False, True])
+def test_dynamic_gru_matches_numpy(rng, origin_mode):
+    B, T, H = 3, 5, 6
+    x_np = rng.randn(B, T, 3 * H).astype("float32") * 0.5
+    length_np = np.array([5, 2, 4], "int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[T, 3 * H])
+        length = fluid.layers.data("length", shape=[], dtype="int64")
+        h = fluid.layers.dynamic_gru(x, size=H, length=length,
+                                     origin_mode=origin_mode)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        hv, = exe.run(main, feed={"x": x_np, "length": length_np},
+                      fetch_list=[h])
+        w = np.asarray(fluid.global_scope().find_var(
+            [p.name for p in main.all_parameters() if ".w" in p.name][0]))
+        b = np.asarray(fluid.global_scope().find_var(
+            [p.name for p in main.all_parameters() if ".b" in p.name][0])).reshape(-1)
+    ref = np_gru(x_np.astype("float64"), w.astype("float64"),
+                 b.astype("float64"), length_np, H, origin_mode)
+    np.testing.assert_allclose(hv, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_unit_and_gru_unit(rng):
+    B, H = 4, 5
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3])
+        h_prev = fluid.layers.data("h_prev", shape=[H])
+        c_prev = fluid.layers.data("c_prev", shape=[H])
+        h, c = fluid.layers.lstm_unit(x, h_prev, c_prev, forget_bias=1.0)
+        xg = fluid.layers.data("xg", shape=[3 * H])
+        hg, _, _ = fluid.layers.gru_unit(xg, h_prev, size=3 * H)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {"x": rng.randn(B, 3).astype("float32"),
+                "h_prev": rng.randn(B, H).astype("float32"),
+                "c_prev": rng.randn(B, H).astype("float32"),
+                "xg": rng.randn(B, 3 * H).astype("float32")}
+        hv, cv, hgv = exe.run(main, feed=feed, fetch_list=[h, c, hg])
+    assert hv.shape == (B, H) and cv.shape == (B, H) and hgv.shape == (B, H)
+    assert np.isfinite(hv).all() and np.isfinite(hgv).all()
+
+
+def test_stacked_bidirectional_lstm_shapes_and_masking(rng):
+    B, T, D, H = 4, 7, 5, 6
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[T, D])
+        length = fluid.layers.data("length", shape=[], dtype="int64")
+        out, last_h, last_c = fluid.layers.lstm(
+            x, hidden_size=H, num_layers=2, is_bidirec=True, length=length)
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        length_np = np.array([7, 4, 2, 6], "int64")
+        ov, hv, cv = exe.run(
+            main, feed={"x": rng.randn(B, T, D).astype("float32"),
+                        "length": length_np},
+            fetch_list=[out, last_h, last_c])
+    assert ov.shape == (B, T, 2 * H)
+    assert hv.shape == (4, B, H) and cv.shape == (4, B, H)
+    # padded positions are zeroed
+    for b_i, L in enumerate(length_np):
+        assert np.all(ov[b_i, L:] == 0.0)
+        if L < T:
+            assert np.any(ov[b_i, :L] != 0.0)
+
+
+def test_dynamic_rnn_matches_dynamic_gru(rng):
+    """A DynamicRNN whose body is a gru_unit must reproduce dynamic_gru."""
+    B, T, H = 3, 5, 4
+    x_np = rng.randn(B, T, 3 * H).astype("float32") * 0.5
+    length_np = np.array([5, 3, 1], "int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[T, 3 * H])
+        length = fluid.layers.data("length", shape=[], dtype="int64")
+        h_ref = fluid.layers.dynamic_gru(
+            x, size=H, length=length,
+            param_attr=fluid.ParamAttr(name="shared_w"),
+            bias_attr=fluid.ParamAttr(name="shared_b"))
+
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x, length=length)
+            prev = drnn.memory(shape=[H], value=0.0)
+            h_t, _, _ = fluid.layers.gru_unit(
+                x_t, prev, size=3 * H,
+                param_attr=fluid.ParamAttr(name="shared_w"),
+                bias_attr=fluid.ParamAttr(name="shared_b"))
+            drnn.update_memory(prev, h_t)
+            drnn.output(h_t)
+        h_drnn = drnn()
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        ref, got = exe.run(main, feed={"x": x_np, "length": length_np},
+                           fetch_list=[h_ref, h_drnn])
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_label_semantic_roles_style_model_trains(rng):
+    """Stacked bidirectional dynamic_lstm token tagger (the book's
+    label_semantic_roles workload shape, tests/book/test_label_semantic_roles.py)."""
+    B, T, V, E, H, NTAG = 8, 10, 50, 16, 16, 5
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 1
+    with fluid.program_guard(main, startup):
+        words = fluid.layers.data("words", shape=[T], dtype="int64")
+        length = fluid.layers.data("length", shape=[], dtype="int64")
+        tags = fluid.layers.data("tags", shape=[T, 1], dtype="int64")
+        emb = fluid.layers.embedding(words, size=[V, E])
+        proj_f = fluid.layers.fc(emb, size=4 * H, num_flatten_dims=2)
+        h_f, _ = fluid.layers.dynamic_lstm(proj_f, size=4 * H, length=length)
+        proj_b = fluid.layers.fc(emb, size=4 * H, num_flatten_dims=2)
+        h_b, _ = fluid.layers.dynamic_lstm(proj_b, size=4 * H, length=length,
+                                           is_reverse=True)
+        feat = fluid.layers.concat([h_f, h_b], axis=2)
+        logits = fluid.layers.fc(feat, size=NTAG, num_flatten_dims=2)
+        ce = fluid.layers.softmax_with_cross_entropy(logits, tags)
+        mask = fluid.layers.unsqueeze(
+            fluid.layers.sequence_mask(length, maxlen=T, dtype="float32"), axes=[2])
+        loss = fluid.layers.elementwise_div(
+            fluid.layers.reduce_sum(fluid.layers.elementwise_mul(ce, mask)),
+            fluid.layers.reduce_sum(mask))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+
+    words_np = rng.randint(0, V, (B, T)).astype("int64")
+    length_np = rng.randint(3, T + 1, (B,)).astype("int64")
+    tags_np = (words_np % NTAG)[..., None].astype("int64")  # learnable mapping
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = []
+        for _ in range(60):
+            lv, = exe.run(main, feed={"words": words_np, "length": length_np,
+                                      "tags": tags_np}, fetch_list=[loss])
+            losses.append(float(np.asarray(lv)))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_machine_translation_style_model_trains(rng):
+    """GRU encoder + attention DynamicRNN decoder (the book's
+    machine_translation workload shape, tests/book/test_machine_translation.py)."""
+    B, TS, TT, V, E, H = 6, 8, 7, 40, 12, 12
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 2
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data("src", shape=[TS], dtype="int64")
+        src_len = fluid.layers.data("src_len", shape=[], dtype="int64")
+        trg = fluid.layers.data("trg", shape=[TT], dtype="int64")
+        trg_len = fluid.layers.data("trg_len", shape=[], dtype="int64")
+        labels = fluid.layers.data("labels", shape=[TT, 1], dtype="int64")
+
+        src_emb = fluid.layers.embedding(src, size=[V, E])
+        enc_proj = fluid.layers.fc(src_emb, size=3 * H, num_flatten_dims=2)
+        enc_out = fluid.layers.dynamic_gru(enc_proj, size=H, length=src_len)
+
+        trg_emb = fluid.layers.embedding(trg, size=[V, E])
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            y_t = drnn.step_input(trg_emb, length=trg_len)
+            enc = drnn.static_input(enc_out)
+            prev = drnn.memory(shape=[H], value=0.0)
+            # dot-product attention over encoder states
+            query = fluid.layers.fc(prev, size=H, bias_attr=False)
+            scores = fluid.layers.matmul(
+                enc, fluid.layers.unsqueeze(query, axes=[2]))  # [B,TS,1]
+            att = fluid.layers.softmax(
+                fluid.layers.squeeze(scores, axes=[2]))
+            ctx_vec = fluid.layers.squeeze(
+                fluid.layers.matmul(fluid.layers.unsqueeze(att, axes=[1]), enc),
+                axes=[1])
+            gates = fluid.layers.fc([y_t, ctx_vec], size=3 * H)
+            h_t, _, _ = fluid.layers.gru_unit(gates, prev, size=3 * H)
+            drnn.update_memory(prev, h_t)
+            drnn.output(h_t)
+        dec_out = drnn()
+        logits = fluid.layers.fc(dec_out, size=V, num_flatten_dims=2)
+        ce = fluid.layers.softmax_with_cross_entropy(logits, labels)
+        mask = fluid.layers.unsqueeze(
+            fluid.layers.sequence_mask(trg_len, maxlen=TT, dtype="float32"),
+            axes=[2])
+        loss = fluid.layers.elementwise_div(
+            fluid.layers.reduce_sum(fluid.layers.elementwise_mul(ce, mask)),
+            fluid.layers.reduce_sum(mask))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+
+    src_np = rng.randint(0, V, (B, TS)).astype("int64")
+    src_len_np = rng.randint(3, TS + 1, (B,)).astype("int64")
+    trg_np = rng.randint(0, V, (B, TT)).astype("int64")
+    trg_len_np = rng.randint(2, TT + 1, (B,)).astype("int64")
+    labels_np = np.roll(trg_np, -1, axis=1)[..., None].astype("int64")
+    with fluid.scope_guard(fluid.Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = []
+        for _ in range(80):
+            lv, = exe.run(main, feed={
+                "src": src_np, "src_len": src_len_np, "trg": trg_np,
+                "trg_len": trg_len_np, "labels": labels_np}, fetch_list=[loss])
+            losses.append(float(np.asarray(lv)))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
