@@ -1,4 +1,13 @@
-from . import attention, control_flow, io, learning_rate_scheduler, nn, rnn, sequence, tensor  # noqa: F401
+from . import attention, beam_search as beam_search_mod, control_flow, io, learning_rate_scheduler, nn, rnn, sequence, tensor  # noqa: F401
+from .beam_search import (  # noqa: F401
+    array_length,
+    array_read,
+    array_to_tensor,
+    array_write,
+    beam_search,
+    beam_search_decode,
+    create_array,
+)
 from .attention import multi_head_attention, scaled_dot_product_attention  # noqa: F401
 from .rnn import dynamic_lstm, dynamic_lstmp, dynamic_gru, lstm, lstm_unit, gru_unit  # noqa: F401
 from .control_flow import (  # noqa: F401

@@ -220,7 +220,11 @@ class DynamicRNN:
         self._in_block = True
         try:
             yield
-        finally:
+        except BaseException:
+            self._in_block = False
+            program._rollback()
+            raise  # don't mask the user's error with a _complete() one
+        else:
             self._in_block = False
             program._rollback()
             self._complete()
@@ -373,7 +377,10 @@ class StaticRNN:
         self._sub_block = program._create_block()
         try:
             yield
-        finally:
+        except BaseException:
+            program._rollback()
+            raise  # don't mask the user's error with a _complete() one
+        else:
             program._rollback()
             self._complete()
 

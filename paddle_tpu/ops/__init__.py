@@ -3,6 +3,7 @@
 from . import (  # noqa: F401
     activation_ops,
     attention_ops,
+    beam_search_ops,
     compare_ops,
     control_flow_ops,
     math_ops,

@@ -1,0 +1,152 @@
+"""Beam search + TensorArray ops.
+
+Reference: ``operators/beam_search_op.cc``, ``operators/math/beam_search.cu``,
+``operators/beam_search_decode_op.cc`` and the TensorArray read/write ops
+(``operators/controlflow/tensor_array_read_write_op.cc``,
+``operators/lod_array_length_op.cc``).
+
+TPU-native redesign:
+- Fluid's beam search walks LoD levels per source sentence on the host;
+  here one step is a fully batched top-k over ``[B, K·V]`` on the MXU/VPU —
+  no ragged structures, the number of live beams is static.
+- LoDTensorArray (dynamically growing list of tensors) becomes a
+  pre-allocated ``[capacity, ...]`` ring buffer plus a write count, carried
+  functionally as a ``(buffer, count)`` pytree — the only representation that
+  composes with ``lax.while_loop``'s fixed carry structure. Writes are
+  ``dynamic_update_index``; growth beyond capacity is an error the layer
+  guards against, not a silent wrap.
+- beam_search_decode backtracks parent pointers with a reversed ``lax.scan``
+  over the static capacity, masking steps beyond the true length.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import OpContext, register_op
+
+# sentinel env value for a created-but-never-written array
+EMPTY_ARRAY = ("__empty_tensor_array__",)
+
+
+@register_op("create_array")
+def create_array_op(ctx: OpContext):
+    ctx.set_output("Out", EMPTY_ARRAY)
+
+
+@register_op("write_to_array")
+def write_to_array_op(ctx: OpContext):
+    x = ctx.input("X")
+    i = ctx.input("I").reshape(()).astype(jnp.int32)
+    arr = ctx.input("Array")
+    capacity = int(ctx.attr("capacity", 512))
+    if arr is None or (isinstance(arr, tuple) and arr == EMPTY_ARRAY):
+        buf = jnp.zeros((capacity,) + tuple(x.shape), x.dtype)
+        count = jnp.zeros((), jnp.int32)
+    else:
+        buf, count = arr
+    # i is traced, so capacity can't be asserted at build time; XLA drops
+    # out-of-bounds scatters, and we saturate the count to match so
+    # array_length never claims elements that were not stored.
+    buf = buf.at[i].set(x)
+    count = jnp.minimum(jnp.maximum(count, i + 1), buf.shape[0])
+    ctx.set_output("Out", (buf, count))
+
+
+@register_op("read_from_array")
+def read_from_array_op(ctx: OpContext):
+    buf, _count = ctx.input("Array")
+    i = ctx.input("I").reshape(()).astype(jnp.int32)
+    ctx.set_output("Out", buf[i])
+
+
+@register_op("lod_array_length")
+def lod_array_length_op(ctx: OpContext):
+    _buf, count = ctx.input("Array")
+    ctx.set_output("Out", count.reshape(1).astype(jnp.int64))
+
+
+@register_op("array_to_tensor")
+def array_to_tensor_op(ctx: OpContext):
+    """Stack a TensorArray into one tensor [capacity, ...] (the
+    array_to_lod_tensor analog — here padding past the write count simply
+    stays zero; the count is emitted for masking)."""
+    buf, count = ctx.input("Array")
+    ctx.set_output("Out", buf)
+    ctx.set_output("OutIndex", count.reshape(1).astype(jnp.int64))
+
+
+@register_op("beam_search")
+def beam_search_op(ctx: OpContext):
+    """One beam-search step, fully batched (reference: beam_search_op.cc).
+
+    PreIds/PreScores [B, K]; Scores = per-step log-probs [B, K, V].
+    Finished beams (pre_id == end_id) survive with unchanged score and emit
+    end_id again; everything else expands to K·V candidates and the top K
+    per batch row win. ParentIdx records which source beam each winner came
+    from, for beam_search_decode's backtrack.
+    """
+    pre_ids = ctx.input("PreIds")
+    pre_scores = ctx.input("PreScores")
+    scores = ctx.input("Scores")
+    end_id = int(ctx.attr("end_id", 0))
+    B, K, V = scores.shape
+
+    finished = pre_ids == end_id  # [B, K]
+    neg_inf = jnp.asarray(-1e9, scores.dtype)
+    if ctx.attr("is_accumulated", False):
+        total = scores  # caller already folded pre_scores in
+    else:
+        total = pre_scores[..., None] + scores  # [B, K, V]
+    # finished beams: single candidate (end_id, pre_score)
+    total = jnp.where(finished[..., None], neg_inf, total)
+    keep_end = jnp.zeros((B, K, V), bool).at[:, :, end_id].set(finished)
+    total = jnp.where(keep_end, pre_scores[..., None], total)
+
+    flat = total.reshape(B, K * V)
+    top_scores, top_idx = jax.lax.top_k(flat, K)  # [B, K]
+    sel_ids = (top_idx % V).astype(pre_ids.dtype)
+    parent = (top_idx // V).astype(jnp.int64)
+    ctx.set_output("SelectedIds", sel_ids)
+    ctx.set_output("SelectedScores", top_scores)
+    ctx.set_output("ParentIdx", parent)
+
+
+@register_op("beam_search_decode")
+def beam_search_decode_op(ctx: OpContext):
+    """Backtrack stacked (ids, parents) into full sequences
+    (reference: beam_search_decode_op.cc).
+
+    Ids/Parents: TensorArray values ((buffer [cap,B,K], count)) or plain
+    stacked [T,B,K] tensors. Outputs SentenceIds [B,K,T_cap] padded with
+    end_id past each sequence's length, plus SentenceScores [B,K].
+    """
+    ids_in = ctx.input("Ids")
+    parents_in = ctx.input("Parents")
+    scores = ctx.input("Scores")
+    end_id = int(ctx.attr("end_id", 0))
+
+    if isinstance(ids_in, tuple):
+        ids_buf, count = ids_in
+    else:
+        ids_buf, count = ids_in, jnp.asarray(ids_in.shape[0], jnp.int32)
+    parents_buf = parents_in[0] if isinstance(parents_in, tuple) else parents_in
+
+    cap, B, K = ids_buf.shape
+    binds = jnp.arange(B)[:, None]  # [B,1] broadcast over K
+
+    def back(cur, t):
+        valid = t < count
+        id_t = ids_buf[t][binds, cur]  # [B,K] gather by beam
+        par_t = parents_buf[t][binds, cur]
+        out = jnp.where(valid, id_t, jnp.asarray(end_id, id_t.dtype))
+        cur = jnp.where(valid, par_t, cur)
+        return cur, out
+
+    init = jnp.tile(jnp.arange(K)[None, :], (B, 1)).astype(jnp.int64)
+    _, outs = jax.lax.scan(back, init, jnp.arange(cap - 1, -1, -1))
+    # outs is [cap, B, K] in reverse time order → [B, K, cap] forward
+    sent = jnp.flip(outs, axis=0).transpose(1, 2, 0)
+    ctx.set_output("SentenceIds", sent)
+    ctx.set_output("SentenceScores", scores)

@@ -46,6 +46,15 @@ def while_op(ctx: OpContext):
         return {n: local[n] for n in carry_names}
 
     init = {n: env[n] for n in carry_names}
+    for n, v in init.items():
+        from .beam_search_ops import EMPTY_ARRAY
+
+        if isinstance(v, tuple) and v == EMPTY_ARRAY:
+            raise ValueError(
+                "TensorArray %r is carried through a While loop but was never "
+                "written before it — its buffer has no shape yet, which breaks "
+                "the loop's fixed carry structure. array_write an init element "
+                "(e.g. at index 0) before entering the loop." % n)
     out = jax.lax.while_loop(cond_fn, body_fn, init)
     # the op's Out slot lists the carry names themselves — rebind them
     for n in carry_names:

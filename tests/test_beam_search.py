@@ -1,0 +1,193 @@
+"""Beam search tests: single-step op vs numpy, backtrack decode vs numpy,
+TensorArray ops, and a full While-loop GRU decode matching a numpy beam
+search on identical weights.
+
+Reference tests: operators/beam_search_op_test.cc,
+beam_search_decode_op_test.cc, test_beam_search_op.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def np_beam_step(pre_ids, pre_scores, logp, end_id):
+    B, K, V = logp.shape
+    total = pre_scores[..., None] + logp
+    for b in range(B):
+        for k in range(K):
+            if pre_ids[b, k] == end_id:
+                total[b, k, :] = -1e9
+                total[b, k, end_id] = pre_scores[b, k]
+    flat = total.reshape(B, K * V)
+    idx = np.argsort(-flat, axis=1)[:, :K]
+    scores = np.take_along_axis(flat, idx, axis=1)
+    return (idx % V).astype("int64"), scores, (idx // V).astype("int64")
+
+
+def test_beam_search_op_matches_numpy(rng):
+    B, K, V = 2, 3, 7
+    pre_ids_np = np.array([[1, 2, 0], [4, 0, 5]], "int64")  # some finished (0)
+    pre_scores_np = rng.randn(B, K).astype("float32")
+    logp_np = np.log(rng.dirichlet(np.ones(V), size=(B, K)).astype("float32"))
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pre_ids = fluid.layers.data("pre_ids", [B, K], "int64",
+                                    append_batch_size=False)
+        pre_scores = fluid.layers.data("pre_scores", [B, K], "float32",
+                                       append_batch_size=False)
+        logp = fluid.layers.data("logp", [B, K, V], "float32",
+                                 append_batch_size=False)
+        sid, ssc, par = fluid.layers.beam_search(
+            pre_ids, pre_scores, None, logp, beam_size=K, end_id=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got_ids, got_scores, got_par = exe.run(
+        main, feed={"pre_ids": pre_ids_np, "pre_scores": pre_scores_np,
+                    "logp": logp_np}, fetch_list=[sid, ssc, par])
+    ref_ids, ref_scores, ref_par = np_beam_step(
+        pre_ids_np, pre_scores_np.astype("float64"), logp_np.astype("float64"), 0)
+    np.testing.assert_allclose(got_scores, ref_scores, rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(got_ids, ref_ids)
+    np.testing.assert_array_equal(got_par, ref_par)
+
+
+def test_tensor_array_write_read_length(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [2, 3], append_batch_size=False)
+        i0 = fluid.layers.fill_constant([1], "int64", 0)
+        i1 = fluid.layers.fill_constant([1], "int64", 1)
+        arr = fluid.layers.array_write(x, i0, capacity=4)
+        arr = fluid.layers.array_write(x * 2.0, i1, array=arr)
+        r0 = fluid.layers.array_read(arr, i0)
+        r1 = fluid.layers.array_read(arr, i1)
+        n = fluid.layers.array_length(arr)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x_np = rng.randn(2, 3).astype("float32")
+    r0v, r1v, nv = exe.run(main, feed={"x": x_np}, fetch_list=[r0, r1, n])
+    np.testing.assert_allclose(r0v, x_np, rtol=1e-6)
+    np.testing.assert_allclose(r1v, 2 * x_np, rtol=1e-6)
+    assert nv[0] == 2
+
+
+def np_full_beam_search(emb, w_in, b_in, w_gru, w_out, b_out, B, K, bos,
+                        end_id, max_len):
+    """Greedy numpy GRU-cell beam search mirroring the program in
+    test_while_loop_beam_decode (origin_mode=False gates [u|r|c])."""
+    V, E = emb.shape
+    H = w_gru.shape[0]
+
+    def sigmoid(v):
+        return 1 / (1 + np.exp(-v))
+
+    pre_ids = np.full((B, K), bos, "int64")
+    pre_scores = np.tile(np.array([0.0] + [-1e9] * (K - 1)), (B, 1))
+    state = np.zeros((B * K, H))
+    ids_hist, par_hist = [], []
+    for _t in range(max_len):
+        x = emb[pre_ids.reshape(-1)] @ w_in + b_in  # [B*K, 3H]
+        h_prev = state
+        ur = sigmoid(x[:, :2 * H] + h_prev @ w_gru[:, :2 * H])
+        u, r = ur[:, :H], ur[:, H:]
+        c = np.tanh(x[:, 2 * H:] + (r * h_prev) @ w_gru[:, 2 * H:])
+        h = u * c + (1 - u) * h_prev
+        logits = h @ w_out + b_out
+        logp = logits - np.log(np.exp(logits - logits.max(-1, keepdims=True)
+                                      ).sum(-1, keepdims=True)) - logits.max(-1, keepdims=True)
+        sid, ssc, par = np_beam_step(pre_ids, pre_scores, logp.reshape(B, K, -1),
+                                     end_id)
+        ids_hist.append(sid)
+        par_hist.append(par)
+        state = h.reshape(B, K, H)[np.arange(B)[:, None], par].reshape(B * K, H)
+        pre_ids, pre_scores = sid, ssc
+    # backtrack
+    T = max_len
+    seqs = np.zeros((B, K, T), "int64")
+    cur = np.tile(np.arange(K), (B, 1))
+    for t in range(T - 1, -1, -1):
+        seqs[:, :, t] = ids_hist[t][np.arange(B)[:, None], cur]
+        cur = par_hist[t][np.arange(B)[:, None], cur]
+    return seqs, pre_scores
+
+
+def test_while_loop_beam_decode_matches_numpy(rng):
+    """Full decode loop: While + beam_search + TensorArrays on a tiny GRU LM,
+    exact match against the numpy reference using identical weights."""
+    B, K, V, E, H, max_len = 2, 3, 11, 6, 8, 5
+    bos, eos = 1, 0
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        pre_ids = fluid.layers.assign(np.full((B, K), bos, "int64"))
+        pre_scores = fluid.layers.assign(
+            np.tile(np.array([0.0] + [-1e9] * (K - 1), "float32"), (B, 1)))
+        state = fluid.layers.assign(np.zeros((B * K, H), "float32"))
+        offset = fluid.layers.assign(
+            (np.arange(B)[:, None] * K).astype("int64"))  # [B,1]
+
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        n = fluid.layers.fill_constant([1], "int64", max_len)
+        zero = fluid.layers.fill_constant([1], "int64", 0)
+        ids_arr = fluid.layers.array_write(
+            fluid.layers.assign(np.zeros((B, K), "int64")), zero,
+            capacity=max_len)
+        par_arr = fluid.layers.array_write(
+            fluid.layers.assign(np.zeros((B, K), "int64")), zero,
+            capacity=max_len)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with w.block():
+            emb = fluid.layers.embedding(
+                pre_ids, size=[V, E],
+                param_attr=fluid.ParamAttr(name="emb_w"))
+            emb_flat = fluid.layers.reshape(emb, [B * K, E])
+            gates = fluid.layers.fc(
+                emb_flat, size=3 * H,
+                param_attr=fluid.ParamAttr(name="in_w"),
+                bias_attr=fluid.ParamAttr(name="in_b"))
+            h, _, _ = fluid.layers.gru_unit(
+                gates, state, size=3 * H,
+                param_attr=fluid.ParamAttr(name="gru_w"),
+                bias_attr=fluid.ParamAttr(name="gru_b",
+                                          initializer=fluid.initializer.Constant(0.0)))
+            logits = fluid.layers.fc(
+                h, size=V, param_attr=fluid.ParamAttr(name="out_w"),
+                bias_attr=fluid.ParamAttr(name="out_b"))
+            logp = fluid.layers.reshape(
+                fluid.layers.log_softmax(logits), [B, K, V])
+            sid, ssc, par = fluid.layers.beam_search(
+                pre_ids, pre_scores, None, logp, beam_size=K, end_id=eos)
+            flat_par = fluid.layers.reshape(
+                fluid.layers.elementwise_add(par, offset), [B * K, 1])
+            new_state = fluid.layers.gather(h, flat_par)
+            fluid.layers.array_write(sid, i, array=ids_arr)
+            fluid.layers.array_write(par, i, array=par_arr)
+            fluid.layers.assign(sid, pre_ids)
+            fluid.layers.assign(ssc, pre_scores)
+            fluid.layers.assign(new_state, state)
+            fluid.layers.increment(i, in_place=True)
+            fluid.layers.less_than(i, n, cond=cond)
+        sent_ids, sent_scores = fluid.layers.beam_search_decode(
+            ids_arr, pre_scores, beam_size=K, end_id=eos, parents=par_arr)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got_ids, got_scores = exe.run(main, feed={},
+                                      fetch_list=[sent_ids, sent_scores])
+        g = fluid.global_scope()
+        emb_w = np.asarray(g.find_var("emb_w")).astype("float64")
+        in_w = np.asarray(g.find_var("in_w")).astype("float64")
+        in_b = np.asarray(g.find_var("in_b")).astype("float64").reshape(-1)
+        gru_w = np.asarray(g.find_var("gru_w")).astype("float64")
+        out_w = np.asarray(g.find_var("out_w")).astype("float64")
+        out_b = np.asarray(g.find_var("out_b")).astype("float64").reshape(-1)
+    ref_ids, ref_scores = np_full_beam_search(
+        emb_w, in_w, in_b, gru_w, out_w, out_b, B, K, bos, eos, max_len)
+    np.testing.assert_array_equal(got_ids, ref_ids)
+    np.testing.assert_allclose(got_scores, ref_scores, rtol=1e-4, atol=1e-4)
