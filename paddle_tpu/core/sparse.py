@@ -1,0 +1,58 @@
+"""Sparse gradients — the SelectedRows equivalent.
+
+Reference: ``framework/selected_rows.h:32`` (rows + value block) and the
+sparse optimizer kernels in ``operators/optimizers/`` (e.g. sgd_op.h's
+SelectedRows branch, adam_op.h lazy mode).
+
+XLA has no sparse tensors (SURVEY §7 hard parts): the TPU-native encoding is
+an explicit ``(ids, rows)`` pair. For an embedding lookup of N ids into a
+[V, D] table, the backward produces ``rows`` of shape [N, D] — O(N·D) HBM
+traffic instead of the O(V·D) dense scatter-add, which is the entire point
+at CTR-scale vocabularies (V ≥ 1e6, N a few thousand).
+
+``merge_rows`` combines duplicate ids with static shapes (sort + segment
+sum); the padded tail gets an out-of-range id, which XLA's scatter semantics
+drop — so downstream row-wise optimizer updates are exact without a
+dynamic-shape ``unique``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class SparseGrad:
+    """Gradient of a row-gathered parameter: ``rows[i]`` is the gradient
+    contribution of table row ``ids[i]``; duplicate ids accumulate."""
+
+    def __init__(self, ids, rows):
+        self.ids = ids
+        self.rows = rows
+
+    def tree_flatten(self):
+        return (self.ids, self.rows), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def __repr__(self):
+        return "SparseGrad(ids=%r, rows=%r)" % (self.ids, self.rows)
+
+
+def merge_rows(ids, rows, invalid_index):
+    """Sum rows of duplicate ids. Returns (uniq_ids [N], merged [N, D]) where
+    positions past the number of distinct ids carry ``invalid_index`` —
+    feed them to ``.at[uniq].set/add`` and XLA drops them (OOB scatter).
+    """
+    order = jnp.argsort(ids)
+    sid = jnp.take(ids, order)
+    srows = jnp.take(rows, order, axis=0)
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+    seg = jnp.cumsum(first.astype(jnp.int32)) - 1
+    merged = jax.ops.segment_sum(srows, seg, num_segments=ids.shape[0])
+    uniq = jnp.full((ids.shape[0],), invalid_index, sid.dtype).at[seg].set(sid)
+    return uniq, merged

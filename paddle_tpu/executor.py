@@ -137,23 +137,69 @@ class _CompiledStep:
             else:
                 loss_name = bw["loss"]
                 param_to_grad = bw["param_to_grad"]
-                param_names = [p for p in param_to_grad if p in state]
+                all_param_names = [p for p in param_to_grad if p in state]
+                block0 = program.global_block
+                sparse_names = [
+                    p for p in all_param_names
+                    if getattr(block0._find_var_recursive(p), "is_sparse_param", False)
+                ]
+                param_names = [p for p in all_param_names if p not in sparse_names]
                 params = {n: state[n] for n in param_names}
                 rest = {n: v for n, v in state.items() if n not in params}
                 fwd_ops = ops[:marker_idx]
                 post_ops = ops[marker_idx + 1 :]
 
-                def fwd(params_in, feeds_in):
+                def fwd(params_in, virtuals_in, feeds_in):
                     env = dict(rest)
                     env.update(_amp_cast_tree(params_in))
                     env.update(_amp_cast_tree(feeds_in))
+                    if virtuals_in:
+                        env["__sparse_virtual__"] = virtuals_in
                     run_block_ops(fwd_ops, env, trace)
                     loss = jnp.sum(env[loss_name].astype(jnp.float32))
                     return loss, env
 
+                virtuals = {}
+                if sparse_names:
+                    # Sparse path (SelectedRows equivalent, core/sparse.py):
+                    # an abstract probe discovers each table's per-step row
+                    # count; zero "virtual rows" become extra grad leaves so
+                    # the table itself is never densely differentiated.
+                    if accum != 1:
+                        raise NotImplementedError(
+                            "is_sparse embeddings + gradient accumulation is "
+                            "not supported yet (per-microbatch row shapes)")
+                    collect = {}
+
+                    def probe(params_in, feeds_in):
+                        env = dict(rest)
+                        env.update(params_in)
+                        env.update(feeds_in)
+                        env["__sparse_collect__"] = collect
+                        run_block_ops(fwd_ops, env, trace)
+                        return 0
+
+                    jax.eval_shape(probe, params, feeds)
+                    missing = [p for p in sparse_names if p not in collect]
+                    if missing:
+                        raise ValueError(
+                            "params marked is_sparse but never looked up "
+                            "sparsely: %s" % missing)
+                    vd = amp_dtype
+                    virtuals = {
+                        w: jnp.zeros(shape, vd if (vd is not None and
+                                                   dt == jnp.float32) else dt)
+                        for w, (shape, dt) in collect.items()
+                    }
+
                 if accum == 1:
-                    (loss_val, env), grads = jax.value_and_grad(
-                        fwd, has_aux=True)(params, feeds)
+                    if virtuals:
+                        (loss_val, env), (grads, vgrads) = jax.value_and_grad(
+                            fwd, argnums=(0, 1), has_aux=True)(
+                                params, virtuals, feeds)
+                    else:
+                        (loss_val, env), grads = jax.value_and_grad(
+                            fwd, has_aux=True)(params, {}, feeds)
                 else:
                     # Gradient accumulation (the reference's multi_batch_merge
                     # pass, ir/multi_batch_merge_pass.cc): split the feed batch
@@ -166,7 +212,7 @@ class _CompiledStep:
                             for n, v in feeds.items()
                         }
                         (li, env), gi = jax.value_and_grad(
-                            fwd, has_aux=True)(params, sub)
+                            fwd, has_aux=True)(params, {}, sub)
                         grads = gi if grads is None else jax.tree_util.tree_map(
                             jnp.add, grads, gi)
                         loss_sum = li if loss_sum is None else loss_sum + li
@@ -177,6 +223,11 @@ class _CompiledStep:
                 env.update(params)
                 for p in param_names:
                     env[param_to_grad[p]] = grads[p]
+                for p in sparse_names:
+                    from .core.sparse import SparseGrad
+
+                    env[param_to_grad[p]] = SparseGrad(
+                        env["__sparse_ids__" + p], vgrads[p])
                 env[grad_var_name(loss_name)] = jnp.ones_like(jnp.sum(env[loss_name]))
                 run_block_ops(post_ops, env, trace, offset=marker_idx + 1)
 

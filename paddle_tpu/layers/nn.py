@@ -181,10 +181,14 @@ def embedding(
     dtype="float32",
     name=None,
 ):
-    """Embedding lookup (reference: layers/nn.py embedding). ``is_sparse`` is
-    accepted for API parity; grads are dense XLA scatter-adds either way."""
+    """Embedding lookup (reference: layers/nn.py embedding). ``is_sparse=True``
+    enables the SelectedRows-equivalent (ids, rows) gradient path — the table
+    gradient stays O(N·D) and row-wise optimizer updates apply lazily (see
+    core/sparse.py, ops/nn_ops.py lookup_table_op)."""
     helper = LayerHelper("embedding", name=name)
     w = helper.create_parameter(param_attr, shape=list(size), dtype=dtype)
+    if is_sparse:
+        w.is_sparse_param = True
     out = helper.create_variable_for_type_inference(dtype)
     padding_idx = -1 if padding_idx is None else (padding_idx if padding_idx >= 0 else size[0] + padding_idx)
     helper.append_op(

@@ -424,8 +424,13 @@ def pool3d_op(ctx):
 def lookup_table_op(ctx: OpContext):
     """Reference: operators/lookup_table_op.cc. Ids [..., 1] int → [..., D].
 
-    Sparse-grad SelectedRows behavior is replaced by dense grads (XLA
-    scatter-add); sharded embeddings live in paddle_tpu/parallel.
+    ``is_sparse=True`` reproduces the SelectedRows gradient path
+    (core/sparse.py): the table is read through ``stop_gradient`` and a
+    zero "virtual rows" tensor [N, D] (an extra differentiated input the
+    executor threads in) is added to the gathered rows, so the backward
+    yields an O(N·D) rows gradient and the O(V·D) dense scatter-add never
+    exists in the graph. Dense mode keeps the plain differentiable gather.
+    Sharded embeddings live in paddle_tpu/parallel.
     """
     w = ctx.input("W")
     ids = ctx.input("Ids")
@@ -434,7 +439,27 @@ def lookup_table_op(ctx: OpContext):
         ids = ids.reshape(ids.shape[:-1])
     ids = ids.astype(jnp.int32)
     padding_idx = ctx.attr("padding_idx", -1)
-    out = jnp.take(w, jnp.maximum(ids, 0), axis=0)
+
+    w_name = ctx.op.inputs["W"][0]
+    env = ctx.env
+    collect = env.get("__sparse_collect__")
+    if collect is not None and ctx.attr("is_sparse", False):
+        d = w.shape[1]
+        if w_name in collect:
+            raise NotImplementedError(
+                "sparse embedding table %r is looked up more than once in one "
+                "program — use is_sparse=False for shared tables" % w_name)
+        collect[w_name] = ((int(np.prod(ids.shape)), d), w.dtype)
+    virtuals = env.get("__sparse_virtual__") or {}
+    if w_name in virtuals:
+        flat_ids = ids.reshape(-1)
+        gathered = jnp.take(jax.lax.stop_gradient(w),
+                            jnp.maximum(flat_ids, 0), axis=0)
+        gathered = gathered.astype(virtuals[w_name].dtype) + virtuals[w_name]
+        out = gathered.reshape(ids.shape + (w.shape[1],))
+        env["__sparse_ids__" + w_name] = flat_ids
+    else:
+        out = jnp.take(w, jnp.maximum(ids, 0), axis=0)
     out = jnp.where((ids >= 0)[..., None], out, jnp.zeros_like(out))
     if padding_idx is not None and padding_idx >= 0:
         out = jnp.where((ids == padding_idx)[..., None], jnp.zeros_like(out), out)

@@ -24,9 +24,22 @@ def _lr(ctx):
     return lr.reshape(()) if hasattr(lr, "reshape") else jnp.asarray(lr)
 
 
+def _sparse(g):
+    from ..core.sparse import SparseGrad
+
+    return g if isinstance(g, SparseGrad) else None
+
+
 @register_op("sgd")
 def sgd_op(ctx: OpContext):
     p, g = ctx.input("Param"), ctx.input("Grad")
+    sg = _sparse(g)
+    if sg is not None:
+        # SelectedRows branch (reference: sgd_op.h sparse path): touch only
+        # the looked-up rows; duplicate ids accumulate in the scatter-add.
+        ctx.set_output("ParamOut", p.at[sg.ids].add(
+            -_lr(ctx).astype(p.dtype) * sg.rows.astype(p.dtype)))
+        return
     ctx.set_output("ParamOut", p - _lr(ctx).astype(p.dtype) * g.astype(p.dtype))
 
 
@@ -35,6 +48,21 @@ def momentum_op(ctx: OpContext):
     p, g, v = ctx.input("Param"), ctx.input("Grad"), ctx.input("Velocity")
     lr = _lr(ctx).astype(p.dtype)
     mu = jnp.asarray(ctx.attr("mu"), p.dtype)
+    sg = _sparse(g)
+    if sg is not None:
+        # lazy rows-only momentum (untouched rows keep stale velocity — the
+        # reference's SelectedRows momentum has the same semantics)
+        from ..core.sparse import merge_rows
+
+        uniq, merged = merge_rows(sg.ids, sg.rows.astype(p.dtype), p.shape[0])
+        v_rows = mu * v[uniq] + merged
+        if ctx.attr("use_nesterov", False):
+            step_rows = (merged + mu * v_rows) * lr
+        else:
+            step_rows = lr * v_rows
+        ctx.set_output("ParamOut", p.at[uniq].add(-step_rows))
+        ctx.set_output("VelocityOut", v.at[uniq].set(v_rows))
+        return
     v_new = mu * v + g.astype(p.dtype)
     if ctx.attr("use_nesterov", False):
         p_new = p - (g.astype(p.dtype) + mu * v_new) * lr
@@ -70,11 +98,28 @@ def adam_op(ctx: OpContext):
     b1 = jnp.asarray(ctx.attr("beta1", 0.9), jnp.float32)
     b2 = jnp.asarray(ctx.attr("beta2", 0.999), jnp.float32)
     eps = jnp.asarray(ctx.attr("epsilon", 1e-8), jnp.float32)
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    sg = _sparse(g)
+    if sg is not None:
+        # lazy-mode sparse adam (reference: adam_op.h SelectedRows branch,
+        # lazy_mode): moments decay and params move ONLY on touched rows.
+        from ..core.sparse import merge_rows
+
+        uniq, merged = merge_rows(sg.ids, sg.rows.astype(jnp.float32),
+                                  p.shape[0])
+        m_rows = b1 * m[uniq] + (1 - b1) * merged
+        v_rows = b2 * v[uniq] + (1 - b2) * jnp.square(merged)
+        step = lr_t * m_rows / (jnp.sqrt(v_rows) + eps)
+        ctx.set_output("ParamOut", p.at[uniq].add(-step.astype(p.dtype)))
+        ctx.set_output("Moment1Out", m.at[uniq].set(m_rows))
+        ctx.set_output("Moment2Out", v.at[uniq].set(v_rows))
+        ctx.set_output("Beta1PowOut", b1p * b1)
+        ctx.set_output("Beta2PowOut", b2p * b2)
+        return
     gf = g.astype(jnp.float32)
     m_new = b1 * m + (1 - b1) * gf
     v_new = b2 * v + (1 - b2) * jnp.square(gf)
     # Reference adam_op.h: lr_t = lr * sqrt(1-beta2^t)/(1-beta1^t)
-    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
     p_new = p.astype(jnp.float32) - lr_t * m_new / (jnp.sqrt(v_new) + eps)
     ctx.set_output("ParamOut", p_new.astype(p.dtype))
     ctx.set_output("Moment1Out", m_new)
@@ -118,6 +163,16 @@ def adagrad_op(ctx: OpContext):
     p, g, moment = ctx.input("Param"), ctx.input("Grad"), ctx.input("Moment")
     lr = _lr(ctx)
     eps = ctx.attr("epsilon", 1e-6)
+    sg = _sparse(g)
+    if sg is not None:
+        from ..core.sparse import merge_rows
+
+        uniq, merged = merge_rows(sg.ids, sg.rows.astype(p.dtype), p.shape[0])
+        m_rows = moment[uniq] + jnp.square(merged)
+        ctx.set_output("ParamOut", p.at[uniq].add(
+            -lr * merged / (jnp.sqrt(m_rows) + eps)))
+        ctx.set_output("MomentOut", moment.at[uniq].set(m_rows))
+        return
     m_new = moment + jnp.square(g)
     ctx.set_output("ParamOut", p - lr * g / (jnp.sqrt(m_new) + eps))
     ctx.set_output("MomentOut", m_new)
