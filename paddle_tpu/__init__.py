@@ -23,6 +23,7 @@ from . import (  # noqa: F401
     clip,
     dataset,
     debugger,
+    imperative,
     initializer,
     io,
     layers,

@@ -1,0 +1,21 @@
+"""Imperative (dygraph) mode — eager execution with define-by-run autograd.
+
+Reference side stack: paddle/fluid/imperative/ (layer.h, tracer.cc) +
+python/paddle/fluid/imperative/ (base.py, layers.py, nn.py). TPU-native
+design notes in tracer.py.
+"""
+
+from . import functional  # noqa: F401
+from .base import enabled, guard, to_variable  # noqa: F401
+from .layers import Layer, PyLayer  # noqa: F401
+from .nn import FC, BatchNorm, Conv2D, Embedding, Pool2D  # noqa: F401
+from .tracer import EagerBlock, Tracer, VarBase, current_tracer, dispatch, trace_fn  # noqa: F401
+
+F = functional
+
+__all__ = [
+    "enabled", "guard", "to_variable", "Layer", "PyLayer",
+    "FC", "BatchNorm", "Conv2D", "Embedding", "Pool2D",
+    "VarBase", "Tracer", "current_tracer", "dispatch", "trace_fn", "F",
+    "functional", "EagerBlock",
+]

@@ -1,0 +1,49 @@
+"""Imperative mode entry points (reference: python/paddle/fluid/imperative/base.py:29,47).
+
+``guard()`` activates a Tracer; inside it, ops run eagerly with autograd
+(see tracer.py) and ``to_variable`` lifts numpy arrays onto the device.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from ..core import unique_name
+from . import tracer as tracer_mod
+from .tracer import Tracer, VarBase
+
+__all__ = ["enabled", "guard", "to_variable"]
+
+
+def enabled() -> bool:
+    """reference: framework._in_imperative_mode()."""
+    return tracer_mod.current_tracer() is not None
+
+
+@contextlib.contextmanager
+def guard(place=None, seed: int = 0):
+    """Enter imperative mode (reference: imperative/base.py:29).
+
+    ``place`` is accepted for API parity; XLA owns device placement.
+    """
+    t = Tracer(seed=seed)
+    tracer_mod._TRACER_STACK.append(t)
+    try:
+        with unique_name.guard():
+            yield t
+    finally:
+        tracer_mod._TRACER_STACK.pop()
+
+
+def to_variable(value, block=None, name=None) -> VarBase:
+    """Lift a numpy array (or VarBase, passthrough) to an eager variable
+    (reference: imperative/base.py:47)."""
+    if isinstance(value, VarBase):
+        return value
+    if not enabled():
+        raise RuntimeError("to_variable could only be called in imperative mode "
+                           "(inside paddle_tpu.imperative.guard())")
+    value = np.asarray(value)
+    return VarBase(value, name=name)

@@ -1,0 +1,401 @@
+"""Imperative (dygraph) engine: eager op dispatch + define-by-run autograd.
+
+The reference's imperative mode routes every appended op through a C++
+Tracer that executes it immediately and records grad-op nodes for a later
+backward walk (reference: paddle/fluid/imperative/tracer.cc:102,
+imperative/layer.h:113 VarBase / :285 OpBase, python/paddle/fluid/framework.py
+``_in_imperative_mode``). JAX is already eager outside ``jit``, so the
+TPU-native design needs no second execution engine: ``dispatch`` runs the
+*same registered op impls* the static Executor traces (core/registry.py),
+eagerly, and records a lightweight autodiff Node per call. ``backward`` walks
+the recorded DAG once, computing each node's input cotangents with
+``jax.vjp`` — the replayed-grad-program structure of the reference, but
+derived from the op's own JAX definition instead of hand-written grad ops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import unique_name
+from ..core.dtypes import convert_dtype, to_jnp_dtype
+from ..core.registry import OpContext, get_op_impl
+
+__all__ = ["VarBase", "Tracer", "dispatch", "trace_fn", "EagerBlock", "current_tracer"]
+
+_TRACER_STACK: List["Tracer"] = []
+
+
+def current_tracer() -> Optional["Tracer"]:
+    return _TRACER_STACK[-1] if _TRACER_STACK else None
+
+
+def _is_float(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+class VarBase:
+    """Eager variable: a jax array + autograd metadata.
+
+    The analog of the reference's ``imperative::VarBase``
+    (imperative/layer.h:113): holds the value, the accumulated gradient, the
+    producing autodiff node, and the ``stop_gradient`` flag.
+    """
+
+    def __init__(self, value, name: Optional[str] = None, stop_gradient: bool = False,
+                 persistable: bool = False, trainable: bool = True,
+                 is_parameter: bool = False):
+        self.value = jnp.asarray(value)
+        self.name = name or unique_name.generate("tmp_var")
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.trainable = trainable
+        self.is_parameter = is_parameter  # trainable-weight flag (vs BN stats etc.)
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self._grad = None
+        self._node: Optional[Node] = None
+
+    # -- reference VarBase surface -------------------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self) -> str:
+        return convert_dtype(str(self.value.dtype))
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.value)
+
+    _numpy = numpy  # reference 1.x spelling: var._numpy()
+
+    def gradient(self) -> Optional[np.ndarray]:
+        return None if self._grad is None else np.asarray(self._grad)
+
+    _gradient = gradient
+
+    def backward(self):
+        backward(self)
+
+    _backward = backward
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def detach(self) -> "VarBase":
+        return VarBase(self.value, name=self.name + ".detach", stop_gradient=True)
+
+    def astype(self, dtype) -> "VarBase":
+        return trace_fn(lambda x: x.astype(to_jnp_dtype(convert_dtype(dtype))), self)
+
+    def __repr__(self):
+        return "VarBase(name=%s, shape=%s, dtype=%s)" % (self.name, self.shape, self.dtype)
+
+    def __len__(self):
+        return int(self.value.shape[0])
+
+    # -- math sugar (taped) ---------------------------------------------------
+    def _binop(self, other, fn):
+        other = other if isinstance(other, VarBase) else jnp.asarray(other)
+        return trace_fn(fn, self, other)
+
+    def __add__(self, o):
+        return self._binop(o, lambda a, b: a + b)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, lambda a, b: a - b)
+
+    def __rsub__(self, o):
+        return self._binop(o, lambda a, b: b - a)
+
+    def __mul__(self, o):
+        return self._binop(o, lambda a, b: a * b)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, lambda a, b: a / b)
+
+    def __rtruediv__(self, o):
+        return self._binop(o, lambda a, b: b / a)
+
+    def __pow__(self, o):
+        return self._binop(o, lambda a, b: a ** b)
+
+    def __matmul__(self, o):
+        return self._binop(o, lambda a, b: a @ b)
+
+    def __neg__(self):
+        return trace_fn(lambda a: -a, self)
+
+    def __getitem__(self, idx):
+        return trace_fn(lambda a: a[idx], self)
+
+
+class Node:
+    """One recorded eager op: enough to replay it under ``jax.vjp``.
+
+    Input arrays are saved at record time (reference OpBase keeps its input
+    VarBase holders alive the same way) because ``.value`` of a VarBase may be
+    overwritten later (e.g. in-place optimizer updates).
+    """
+
+    __slots__ = ("fn", "in_vars", "in_arrays", "out_vars")
+
+    def __init__(self, fn, in_vars, in_arrays, out_vars):
+        self.fn = fn                  # fn(*in_arrays) -> tuple(out arrays)
+        self.in_vars = in_vars        # List[Optional[VarBase]], parallel to in_arrays
+        self.in_arrays = in_arrays
+        self.out_vars = out_vars      # Tuple[Optional[VarBase]]
+
+
+def _record(fn, in_vars, in_arrays, out_arrays) -> Tuple[VarBase, ...]:
+    """Wrap eager outputs in VarBases and, if any input needs grad, link a Node."""
+    out_vars = tuple(
+        None if a is None else VarBase(a, stop_gradient=True) for a in out_arrays
+    )
+    needs_grad = any(
+        v is not None and not v.stop_gradient and _is_float(a)
+        for v, a in zip(in_vars, in_arrays)
+    )
+    if needs_grad:
+        node = Node(fn, list(in_vars), list(in_arrays), out_vars)
+        for ov in out_vars:
+            if ov is not None and _is_float(ov.value):
+                ov.stop_gradient = False
+                ov._node = node
+    return out_vars
+
+
+def trace_fn(fn, *inputs, **kwargs):
+    """Apply a pure jnp function to VarBase/array inputs, eagerly, on the tape.
+
+    The dygraph PyLayer primitive: any JAX-traceable function becomes a
+    differentiable eager op.
+    """
+    in_vars = [x if isinstance(x, VarBase) else None for x in inputs]
+    in_arrays = [x.value if isinstance(x, VarBase) else jnp.asarray(x) for x in inputs]
+    f = (lambda *a: fn(*a, **kwargs)) if kwargs else fn
+    out = f(*in_arrays)
+    multi = isinstance(out, (tuple, list))
+    outs = tuple(out) if multi else (out,)
+    fn_tuple = (lambda *a: tuple(f(*a))) if multi else (lambda *a: (f(*a),))
+    out_vars = _record(fn_tuple, in_vars, in_arrays, outs)
+    return out_vars if multi else out_vars[0]
+
+
+class _FakeOp:
+    """Minimal symbolic-op shim so registered impls run outside a Program."""
+
+    __slots__ = ("type", "inputs", "outputs", "attrs", "block")
+
+    def __init__(self, type_, inputs, outputs, attrs):
+        self.type = type_
+        self.inputs = inputs
+        self.outputs = outputs
+        self.attrs = attrs
+        self.block = None
+
+
+class _EagerTrace:
+    """TraceContext stand-in for eager op execution (rng + test mode)."""
+
+    def __init__(self, rng_key, is_test: bool):
+        self.base_rng = rng_key
+        self.is_test = is_test
+        self.current_op_idx = 0
+        self.mesh = None
+        self.program = None
+
+    def op_rng(self, ctx: OpContext):
+        seed = ctx.attr("seed", 0)
+        key = jax.random.PRNGKey(seed) if seed else self.base_rng
+        return jax.random.fold_in(key, self.current_op_idx)
+
+
+def _flatten_slots(d: Optional[Dict[str, Any]], prefix: str):
+    """slot→(value|list) dict → (op slot-name map, [(name, value)] pairs)."""
+    slot_names: Dict[str, List[str]] = {}
+    flat: List[Tuple[str, Any]] = []
+    for slot, val in (d or {}).items():
+        if val is None:
+            continue
+        vals = list(val) if isinstance(val, (list, tuple)) else [val]
+        names = []
+        for i, v in enumerate(vals):
+            n = "__%s_%s_%d" % (prefix, slot, i)
+            names.append(n)
+            flat.append((n, v))
+        slot_names[slot] = names
+    return slot_names, flat
+
+
+def dispatch(type_: str, inputs: Dict[str, Any], attrs: Optional[Dict[str, Any]] = None,
+             out_slots: Sequence[str] = ("Out",), is_test: Optional[bool] = None):
+    """Run a registered op eagerly with autograd.
+
+    ``inputs`` maps slot → VarBase | array | list thereof (None skipped);
+    returns one VarBase per out slot (single value if one slot). This is the
+    imperative twin of the static tracer's op step — same registry, same
+    semantics, so every op in paddle_tpu/ops/ works in dygraph.
+    """
+    tracer = current_tracer()
+    op_inputs, flat = _flatten_slots(inputs, "in")
+    flat_names = [n for n, _ in flat]
+    flat_vals = [v for _, v in flat]
+    op_outputs = {s: ["__out_%s" % s] for s in out_slots}
+    op = _FakeOp(type_, op_inputs, op_outputs, dict(attrs or {}))
+    impl = get_op_impl(type_)
+    if is_test is None:
+        is_test = not (tracer.training if tracer else True)
+    rng_key = tracer.next_rng() if tracer else jax.random.PRNGKey(0)
+
+    in_vars = [v if isinstance(v, VarBase) else None for v in flat_vals]
+    in_arrays = [v.value if isinstance(v, VarBase) else jnp.asarray(v) for v in flat_vals]
+
+    def fn_core(*arrays):
+        env = dict(zip(flat_names, arrays))
+        impl(OpContext(op, env, _EagerTrace(rng_key, is_test)))
+        return tuple(env.get("__out_%s" % s) for s in out_slots)
+
+    outs = fn_core(*in_arrays)
+    out_vars = _record(fn_core, in_vars, in_arrays, outs)
+    return out_vars if len(out_slots) > 1 else out_vars[0]
+
+
+def backward(loss: VarBase):
+    """Reverse pass from ``loss`` over the recorded DAG.
+
+    Reverse post-order DFS over producer links gives a topological order in
+    which every consumer is processed before the node that produced its
+    inputs, so each node runs its ``jax.vjp`` exactly once with complete
+    output cotangents (the reference's sorted grad-op replay,
+    imperative/layer.cc ApplyGrad).
+    """
+    if loss._node is None and loss.stop_gradient:
+        raise RuntimeError(
+            "backward() on a variable with no recorded graph — did every "
+            "input have stop_gradient=True?")
+    order: List[Node] = []
+    seen = set()
+    stack: List[Tuple[Node, bool]] = [(loss._node, False)] if loss._node else []
+    while stack:
+        node, done = stack.pop()
+        if done:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for v in node.in_vars:
+            if v is not None and v._node is not None and id(v._node) not in seen:
+                stack.append((v._node, False))
+    # A repeated backward must not compound stale intermediate cotangents:
+    # clear every non-leaf grad in the subgraph, then re-seed. Leaves
+    # (parameters / user vars not produced by a node) keep accumulating,
+    # matching the reference's VarBase grad accumulation semantics.
+    for node in order:
+        for ov in node.out_vars:
+            if ov is not None:
+                ov._grad = None
+    loss._grad = jnp.ones_like(loss.value)
+    for node in reversed(order):
+        _node_backward(node)
+
+
+def _node_backward(node: Node):
+    diff_pos = [
+        i for i, (v, a) in enumerate(zip(node.in_vars, node.in_arrays))
+        if v is not None and _is_float(a)
+    ]
+    out_pos = [
+        j for j, ov in enumerate(node.out_vars)
+        if ov is not None and _is_float(ov.value)
+    ]
+    if not diff_pos or not out_pos:
+        return
+    cts = []
+    any_ct = False
+    for j in out_pos:
+        g = node.out_vars[j]._grad
+        if g is None:
+            cts.append(jnp.zeros_like(node.out_vars[j].value))
+        else:
+            cts.append(g)
+            any_ct = True
+    if not any_ct:
+        return
+
+    def f_diff(*diff_arrays):
+        full = list(node.in_arrays)
+        for p, a in zip(diff_pos, diff_arrays):
+            full[p] = a
+        outs = node.fn(*full)
+        return tuple(outs[j] for j in out_pos)
+
+    primals = tuple(node.in_arrays[p] for p in diff_pos)
+    _, vjp_fn = jax.vjp(f_diff, *primals)
+    in_cts = vjp_fn(tuple(cts))
+    for p, ct in zip(diff_pos, in_cts):
+        v = node.in_vars[p]
+        if v.stop_gradient:
+            continue
+        v._grad = ct if v._grad is None else v._grad + ct
+
+
+class EagerBlock:
+    """Block stand-in whose ``append_op`` executes immediately, in place.
+
+    Used where static code appends state-mutating ops — parameter
+    initializers and optimizer update ops. Inputs/outputs may be VarBase or
+    any object with a ``.value`` array (optimizer accumulator slots); outputs
+    are written back in place with no autograd (these ops are leaves).
+    """
+
+    def append_op(self, type_, inputs=None, outputs=None, attrs=None):
+        op_inputs, in_flat = _flatten_slots(inputs, "in")
+        env = {n: getattr(v, "value", v) for n, v in in_flat}
+        op_outputs, out_flat = _flatten_slots(outputs, "out")
+        out_objs = dict(out_flat)
+        op = _FakeOp(type_, op_inputs, op_outputs, dict(attrs or {}))
+        tracer = current_tracer()
+        rng = tracer.next_rng() if tracer else jax.random.PRNGKey(0)
+        get_op_impl(type_)(OpContext(op, env, _EagerTrace(rng, is_test=False)))
+        for n, obj in out_objs.items():
+            if n in env:
+                obj.value = env[n]
+        return op
+
+
+class Tracer:
+    """Per-guard state: parameter registry, RNG stream, train/eval mode."""
+
+    def __init__(self, seed: int = 0):
+        self._params: Dict[str, VarBase] = {}
+        self._key = jax.random.PRNGKey(seed or 0)
+        self._counter = 0
+        self.training = True
+
+    def next_rng(self):
+        self._counter += 1
+        return jax.random.fold_in(self._key, self._counter)
+
+    def register_parameter(self, p: VarBase):
+        self._params[p.name] = p
+
+    def parameters(self) -> List[VarBase]:
+        return list(self._params.values())
+
+    def train_mode(self):
+        self.training = True
+
+    def eval_mode(self):
+        self.training = False

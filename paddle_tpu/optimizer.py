@@ -46,6 +46,16 @@ __all__ = [
 ]
 
 
+class _EagerSlot:
+    """Mutable array holder for imperative-mode accumulators/LR (duck-typed
+    like VarBase for EagerBlock's in-place output writes)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+
 class Optimizer:
     """Base optimizer (reference: optimizer.py:44)."""
 
@@ -90,6 +100,16 @@ class Optimizer:
     def _add_accumulator(self, name: str, param: Parameter, dtype=None, fill_value=0.0, shape=None):
         if name in self._accumulators and param.name in self._accumulators[name]:
             return self._accumulators[name][param.name]
+        if getattr(self, "_imperative", False):
+            import jax.numpy as jnp
+
+            from .core.dtypes import to_jnp_dtype
+
+            shape = tuple(shape if shape is not None else param.shape)
+            slot = _EagerSlot(jnp.full(shape, float(fill_value),
+                                       to_jnp_dtype(dtype or "float32")))
+            self._accumulators.setdefault(name, {})[param.name] = slot
+            return slot
         acc_name = unique_name.generate("%s_%s_%s" % (param.name, self.type, name))
         shape = list(shape if shape is not None else param.shape)
         dtype = dtype or "float32"
@@ -142,7 +162,20 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
         """reference: optimizer.py:357. Ops are appended to the *loss's*
-        program, not whatever default program is active at call time."""
+        program, not whatever default program is active at call time.
+
+        In imperative (dygraph) mode the same per-optimizer update ops run
+        eagerly instead (reference: optimizer.py minimize under
+        _in_imperative_mode)."""
+        from .imperative import base as _imp
+
+        if _imp.enabled():
+            return self._imperative_minimize(loss, parameter_list, no_grad_set)
+        if getattr(self, "_imperative", False):
+            raise RuntimeError(
+                "This optimizer instance was used in imperative mode; its "
+                "accumulators are eager arrays and cannot drive a static "
+                "program. Create a fresh optimizer per mode.")
         from .core.framework import program_guard
 
         with program_guard(loss.block.program, startup_program):
@@ -150,7 +183,55 @@ class Optimizer:
             optimize_ops = self.apply_gradients(params_grads)
         return optimize_ops, params_grads
 
+    def _imperative_minimize(self, loss, parameter_list=None, no_grad_set=None):
+        """Dygraph optimize step: run `_append_optimize_op` with an
+        EagerBlock so every subclass's update math is reused unchanged.
+        Accumulators live as eager arrays; in-place ParamOut writes go
+        straight to the VarBase values. Gradient *clipping* is not wired in
+        dygraph v0 (reference 1.x dygraph had the same gap)."""
+        import jax.numpy as jnp
+
+        from .imperative.tracer import EagerBlock, current_tracer
+
+        if isinstance(self._learning_rate, Variable):
+            raise NotImplementedError(
+                "LR-scheduler Variables are a static-graph feature; use a "
+                "float learning rate (optionally updated between steps) in "
+                "imperative mode.")
+        self._imperative = True
+        no_grad = {getattr(v, "name", v) for v in (no_grad_set or ())}
+        params = parameter_list if parameter_list is not None else current_tracer().parameters()
+        params = sorted((p for p in params
+                         if p.trainable and p._grad is not None and p.name not in no_grad),
+                        key=lambda p: p.name)
+        block = EagerBlock()
+        self._create_accumulators(block, params)
+        params_grads, ops = [], []
+        for p in params:
+            g = p._grad
+            reg = getattr(p, "regularizer", None) or self.regularization
+            if reg is not None:
+                from .regularizer import L1DecayRegularizer, L2DecayRegularizer
+
+                if isinstance(reg, L2DecayRegularizer):
+                    g = g + reg._coeff * p.value
+                elif isinstance(reg, L1DecayRegularizer):
+                    g = g + reg._coeff * jnp.sign(p.value)
+                else:
+                    raise NotImplementedError("unsupported regularizer in dygraph: %r" % reg)
+            params_grads.append((p, g))
+            ops.append(self._append_optimize_op(block, (p, g)))
+        self._finish_update(block, params_grads)
+        return ops, params_grads
+
     def _lr_input(self, param=None):
+        if getattr(self, "_imperative", False):
+            import jax.numpy as jnp
+
+            plr = 1.0 if param is None else getattr(
+                param, "optimize_attr", {"learning_rate": 1.0}).get("learning_rate", 1.0)
+            return _EagerSlot(jnp.full((1,), float(self._learning_rate) * float(plr),
+                                       jnp.float32))
         lr = self._global_learning_rate()
         plr = 1.0
         if param is not None:
@@ -254,19 +335,26 @@ class AdamOptimizer(Optimizer):
             self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1])
             self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2, shape=[1])
 
+    def _extra_attrs(self):
+        """Attrs beyond plain Adam's (AdamW/Lamb decay). Must be supplied
+        before append_op: in imperative mode the op executes immediately."""
+        return {}
+
     def _append_optimize_op(self, block, param_and_grad):
         p, g = param_and_grad
         m1 = self._get_accumulator("moment1", p)
         m2 = self._get_accumulator("moment2", p)
         b1p = self._get_accumulator("beta1_pow_acc", p)
         b2p = self._get_accumulator("beta2_pow_acc", p)
+        attrs = {"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon}
+        attrs.update(self._extra_attrs())
         return block.append_op(
-            "adam",
+            self.type,
             inputs={"Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
                     "Beta1Pow": b1p, "Beta2Pow": b2p, "LearningRate": self._lr_input(p)},
             outputs={"ParamOut": p, "Moment1Out": m1, "Moment2Out": m2,
                      "Beta1PowOut": b1p, "Beta2PowOut": b2p},
-            attrs={"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon},
+            attrs=attrs,
         )
 
 
@@ -277,11 +365,8 @@ class AdamWOptimizer(AdamOptimizer):
         super().__init__(learning_rate, **kw)
         self._weight_decay = weight_decay
 
-    def _append_optimize_op(self, block, param_and_grad):
-        op = super()._append_optimize_op(block, param_and_grad)
-        op.type = "adamw"
-        op.attrs["weight_decay"] = self._weight_decay
-        return op
+    def _extra_attrs(self):
+        return {"weight_decay": self._weight_decay}
 
 
 class AdamaxOptimizer(Optimizer):
@@ -431,11 +516,8 @@ class LambOptimizer(AdamOptimizer):
         super().__init__(learning_rate, beta1=beta1, beta2=beta2, epsilon=epsilon, **kw)
         self._weight_decay = lamb_weight_decay
 
-    def _append_optimize_op(self, block, param_and_grad):
-        op = super()._append_optimize_op(block, param_and_grad)
-        op.type = "lamb"
-        op.attrs["weight_decay"] = self._weight_decay
-        return op
+    def _extra_attrs(self):
+        return {"weight_decay": self._weight_decay}
 
 
 # Fluid-style short aliases

@@ -1,0 +1,269 @@
+"""Dygraph (imperative) mode tests.
+
+Mirrors the reference's imperative suite
+(python/paddle/fluid/tests/unittests/test_imperative_basic.py,
+test_imperative_optimizer.py): Layer/parameter mechanics, eager autograd,
+optimizer parity with static mode, and an MNIST-style MLP trained to
+convergence in dygraph.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import imperative
+from paddle_tpu.imperative import F, to_variable
+
+
+def _synthetic(rng, n=256, dim=32, classes=10):
+    centers = rng.randn(classes, dim).astype("float32") * 3.0
+    ys = rng.randint(0, classes, size=n)
+    xs = centers[ys] + rng.randn(n, dim).astype("float32") * 0.5
+    return xs.astype("float32"), ys.reshape(n, 1).astype("int64")
+
+
+class MLP(imperative.Layer):
+    def __init__(self, name_scope, dim=32, classes=10):
+        super().__init__(name_scope)
+        self._fc1 = imperative.FC(self.full_name(), 64, act="relu")
+        self._fc2 = imperative.FC(self.full_name(), classes)
+
+    def forward(self, x):
+        return self._fc2(self._fc1(x))
+
+
+def test_to_variable_roundtrip_and_guard():
+    assert not imperative.enabled()
+    with imperative.guard():
+        assert imperative.enabled()
+        x = to_variable(np.arange(6, dtype="float32").reshape(2, 3))
+        assert x.shape == (2, 3)
+        assert x.dtype == "float32"
+        np.testing.assert_array_equal(x.numpy(), np.arange(6).reshape(2, 3))
+    assert not imperative.enabled()
+    with pytest.raises(RuntimeError):
+        to_variable(np.zeros(3))
+
+
+def test_eager_autograd_matches_analytic():
+    with imperative.guard():
+        x = to_variable(np.array([[1.0, 2.0], [3.0, 4.0]], dtype="float32"))
+        y = x * x + 2.0 * x  # dy/dx = 2x + 2
+        loss = F.mean(y)
+        loss._backward()
+        expect = (2.0 * x.numpy() + 2.0) / x.numpy().size
+        np.testing.assert_allclose(x.gradient(), expect, rtol=1e-6)
+
+
+def test_grad_accumulates_across_uses():
+    with imperative.guard():
+        x = to_variable(np.ones((3,), dtype="float32"))
+        y = x * 3.0
+        z = x * 5.0
+        loss = F.reduce_sum(y + z)
+        loss.backward()
+        np.testing.assert_allclose(x.gradient(), np.full(3, 8.0), rtol=1e-6)
+
+
+def test_stop_gradient_blocks_flow():
+    with imperative.guard():
+        x = to_variable(np.ones((3,), dtype="float32"))
+        w = to_variable(np.ones((3,), dtype="float32"))
+        w.stop_gradient = True
+        loss = F.reduce_sum(x * w)
+        loss.backward()
+        assert x.gradient() is not None
+        assert w.gradient() is None
+
+
+def test_layer_parameter_registry():
+    with imperative.guard():
+        mlp = MLP("mlp")
+        mlp(to_variable(np.zeros((4, 32), dtype="float32")))  # builds lazy FCs
+        params = mlp.parameters()
+        assert len(params) == 4  # 2 FCs × (w, b)
+        assert len(mlp.sublayers()) == 2
+        assert all(p.persistable for p in params)
+        # clear_gradients wipes accumulated grads
+        loss = F.mean(mlp(to_variable(np.ones((4, 32), dtype="float32"))))
+        loss.backward()
+        assert any(p.gradient() is not None for p in params)
+        mlp.clear_gradients()
+        assert all(p.gradient() is None for p in params)
+
+
+def test_pylayer_custom_op():
+    class Square(imperative.PyLayer):
+        @staticmethod
+        def forward(x):
+            return x * x
+
+    with imperative.guard():
+        x = to_variable(np.array([2.0, 3.0], dtype="float32"))
+        y = Square.apply(x)
+        F.reduce_sum(y).backward()
+        np.testing.assert_allclose(x.gradient(), [4.0, 6.0], rtol=1e-6)
+
+
+def test_imperative_mnist_mlp_converges(rng):
+    xs, ys = _synthetic(rng)
+    with imperative.guard(seed=7):
+        mlp = MLP("mlp")
+        opt = fluid.optimizer.Adam(learning_rate=1e-2)
+        batch = 64
+        first = last = None
+        for epoch in range(4):
+            for i in range(0, len(xs), batch):
+                img = to_variable(xs[i:i + batch])
+                label = to_variable(ys[i:i + batch])
+                label.stop_gradient = True
+                loss = F.mean(F.softmax_with_cross_entropy(mlp(img), label))
+                loss._backward()
+                opt.minimize(loss)
+                mlp.clear_gradients()
+                if first is None:
+                    first = float(loss.numpy())
+                last = float(loss.numpy())
+    assert last < 0.3, f"dygraph MLP did not converge: {first} -> {last}"
+    assert last < first
+
+
+def test_imperative_sgd_matches_static(rng):
+    """One SGD step on identical weights/grads must match static mode."""
+    dim, classes = 8, 3
+    xs = rng.randn(16, dim).astype("float32")
+    ys = rng.randint(0, classes, size=(16, 1)).astype("int64")
+    w0 = rng.randn(dim, classes).astype("float32") * 0.1
+    b0 = np.zeros(classes, dtype="float32")
+
+    # -- static
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[dim])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        logits = fluid.layers.fc(
+            img, size=classes,
+            param_attr=fluid.ParamAttr(
+                name="w", initializer=fluid.initializer.NumpyArrayInitializer(w0)),
+            bias_attr=fluid.ParamAttr(
+                name="b", initializer=fluid.initializer.NumpyArrayInitializer(b0)))
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    static_loss, = exe.run(main, feed={"img": xs, "label": ys}, fetch_list=[loss])
+    static_w = fluid.global_scope().as_numpy("w")
+
+    # -- imperative
+    with imperative.guard():
+        fc = imperative.FC("fc", classes)
+        fc(to_variable(xs))  # build
+        fc.weight.value = jnp.asarray(w0)
+        fc.bias.value = jnp.asarray(b0)
+        label = to_variable(ys)
+        label.stop_gradient = True
+        dloss = F.mean(F.softmax_with_cross_entropy(fc(to_variable(xs)), label))
+        dloss._backward()
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(dloss)
+        np.testing.assert_allclose(float(dloss.numpy()), float(static_loss), rtol=1e-5)
+        np.testing.assert_allclose(fc.weight.numpy(), static_w, rtol=1e-5, atol=1e-6)
+
+
+def test_imperative_conv_pool_bn_smoke(rng):
+    x = rng.randn(2, 3, 16, 16).astype("float32")
+    with imperative.guard():
+        conv = imperative.Conv2D("conv", num_channels=3, num_filters=8,
+                                 filter_size=3, padding=1, act="relu")
+        pool = imperative.Pool2D("pool", pool_size=2, pool_stride=2)
+        bn = imperative.BatchNorm("bn", num_channels=8)
+        emb = imperative.Embedding("emb", size=[50, 6])
+
+        out = pool(conv(to_variable(x)))
+        assert out.shape == (2, 8, 8, 8)
+        mean_before = bn._mean.numpy().copy()
+        out = bn(out)
+        assert not np.allclose(bn._mean.numpy(), mean_before), "BN stats must update"
+        ids = to_variable(rng.randint(0, 50, size=(4, 7)).astype("int64"))
+        e = emb(ids)
+        assert e.shape == (4, 7, 6)
+        loss = F.mean(out) + F.mean(e)
+        loss.backward()
+        assert conv.weight.gradient() is not None
+        assert emb.weight.gradient() is not None
+
+
+def test_double_backward_does_not_compound():
+    """Repeated backward accumulates into leaves linearly, never compounds
+    through stale intermediate cotangents."""
+    with imperative.guard():
+        x = to_variable(np.ones((3,), dtype="float32"))
+        loss = F.reduce_sum((x * 2.0) * 3.0)
+        loss.backward()
+        np.testing.assert_allclose(x.gradient(), np.full(3, 6.0))
+        loss.backward()
+        np.testing.assert_allclose(x.gradient(), np.full(3, 12.0))
+
+
+def test_adamw_decays_lamb_differs():
+    """AdamW's weight decay must actually apply in dygraph (not degrade to
+    Adam), and Lamb must take its own path."""
+    w0 = np.full((4, 4), 2.0, dtype="float32")
+
+    def one_step(make_opt):
+        with imperative.guard():
+            fc = imperative.FC("fc", 4, bias_attr=False)
+            fc(to_variable(np.ones((2, 4), dtype="float32")))
+            fc.weight.value = jnp.asarray(w0)
+            loss = F.mean(fc(to_variable(np.ones((2, 4), dtype="float32"))))
+            loss.backward()
+            make_opt().minimize(loss)
+            return fc.weight.numpy()
+
+    adam = one_step(lambda: fluid.optimizer.Adam(learning_rate=0.1))
+    adamw = one_step(lambda: fluid.optimizer.AdamW(learning_rate=0.1, weight_decay=0.5))
+    lamb = one_step(lambda: fluid.optimizer.Lamb(learning_rate=0.1))
+    assert not np.allclose(adam, adamw), "AdamW must differ from Adam (weight decay)"
+    assert not np.allclose(adam, lamb), "Lamb must differ from Adam (trust ratio)"
+    assert adamw.mean() < adam.mean(), "decay must pull weights toward zero"
+
+
+def test_optimizer_cannot_switch_modes(rng):
+    with imperative.guard():
+        x = to_variable(np.ones((2, 4), dtype="float32"))
+        fc = imperative.FC("fc", 2)
+        loss = F.mean(fc(x))
+        loss.backward()
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[4])
+        static_loss = fluid.layers.mean(fluid.layers.fc(img, size=2))
+        with pytest.raises(RuntimeError, match="imperative"):
+            opt.minimize(static_loss)
+
+
+def test_bn_stats_are_not_parameters():
+    with imperative.guard():
+        bn = imperative.BatchNorm("bn", num_channels=4)
+        names = sorted(p.name for p in bn.parameters())
+        assert len(names) == 2, f"BN must expose scale+bias only, got {names}"
+
+
+def test_imperative_adam_state_persists(rng):
+    """Accumulators (moments) must persist across minimize calls."""
+    with imperative.guard():
+        x = to_variable(np.ones((4, 8), dtype="float32"))
+        fc = imperative.FC("fc", 4)
+        opt = fluid.optimizer.Adam(learning_rate=1e-2)
+        losses = []
+        for _ in range(3):
+            loss = F.mean(F.square(fc(x)))
+            loss.backward()
+            opt.minimize(loss)
+            fc.clear_gradients()
+            losses.append(float(loss.numpy()))
+        accs = opt._accumulators["moment1"]
+        assert len(accs) == 2  # w and b
+        assert losses[-1] < losses[0]
