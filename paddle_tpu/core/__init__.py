@@ -17,3 +17,4 @@ from .framework import (  # noqa: F401
 from .place import CPUPlace, CUDAPinnedPlace, Place, TPUPlace, is_compiled_with_tpu  # noqa: F401
 from .registry import OpContext, get_op_impl, has_op, register_op, registered_ops  # noqa: F401
 from .scope import Scope, global_scope, scope_guard  # noqa: F401
+from ..reader.py_reader import EOFException  # noqa: F401  (fluid.core.EOFException parity)

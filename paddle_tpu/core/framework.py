@@ -301,6 +301,9 @@ class Program:
         self._backward_info: Optional[Dict[str, Any]] = None
         # Optimization metadata (lr scheduler var names etc.)
         self._lr_var_name: Optional[str] = None
+        # PyReaders bound to this program's data vars (layers.io.py_reader);
+        # the Executor drains one batch per run. Not carried by clone().
+        self._py_readers: List[Any] = []
 
     # -- block management -----------------------------------------------------
     @property

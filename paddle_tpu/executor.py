@@ -339,7 +339,14 @@ class Executor:
             program = default_main_program()
         if scope is None:
             scope = global_scope()
-        feed = feed or {}
+        feed = dict(feed or {})
+        # py_reader-fed programs: drain one batch per run for each started
+        # reader whose vars aren't explicitly fed (reference: the in-graph
+        # `read` op popping the blocking queue; raises EOFException at end).
+        for reader in getattr(program, "_py_readers", ()):
+            if reader._started and not all(n in feed for n in reader.var_names):
+                for n, v in reader.next_feed().items():
+                    feed.setdefault(n, v)  # explicit feed wins over the queue
         fetch_names = self._fetch_names(fetch_list)
 
         block = program.global_block

@@ -18,6 +18,8 @@ _DEFAULTS: Dict[str, Any] = {
     # honored
     "check_nan_inf": False,          # post-step NaN/Inf scan (operator.cc:947)
     "benchmark": False,              # block_until_ready every step (operator.cc:942)
+    "strict_fused_attention": False, # raise (not warn+fallback) if the Pallas
+                                     # flash-attention call fails on TPU
     "eager_delete_tensor_gb": 0.0,   # accepted; XLA buffer liveness handles it
     # accepted for compatibility, no-ops under XLA
     "fraction_of_gpu_memory_to_use": 0.92,

@@ -143,15 +143,21 @@ class BatchNorm(Layer):
         self._variance.value = jnp.ones((num_channels,), to_jnp_dtype(convert_dtype(dtype)))
 
     def forward(self, input):
+        from . import tracer as tracer_mod
+
+        t = tracer_mod.current_tracer()
+        # constructor is_test=True pins inference; otherwise follow the
+        # tracer's train/eval mode (Layer.eval()) like the static trace does
+        is_test = True if self._is_test else (t is not None and not t.training)
         y, mean_out, var_out = dispatch(
             "batch_norm",
             {"X": input, "Scale": self.weight, "Bias": self.bias,
              "Mean": self._mean, "Variance": self._variance},
             attrs={"momentum": self._momentum, "epsilon": self._epsilon,
-                   "data_layout": self._layout, "is_test": self._is_test,
+                   "data_layout": self._layout, "is_test": is_test,
                    "use_global_stats": self._use_global_stats},
             out_slots=("Y", "MeanOut", "VarianceOut"))
-        if not self._is_test:
+        if not is_test:
             self._mean.value = mean_out.value
             self._variance.value = var_out.value
         return _act(y, self._act)
@@ -164,13 +170,15 @@ class Embedding(Layer):
                  padding_idx=None, param_attr=None, dtype="float32"):
         super().__init__(name_scope, dtype)
         self._size = size
-        self._padding_idx = -1 if padding_idx is None else padding_idx
+        # normalize to a non-negative row index (the op impl only masks >= 0)
+        self._padding_idx = (-1 if padding_idx is None
+                             else padding_idx if padding_idx >= 0
+                             else size[0] + padding_idx)
         self.weight = self.create_parameter(
             attr=param_attr, shape=list(size), dtype=dtype,
             default_initializer=init_mod.Xavier())
         if padding_idx is not None:
-            pad = padding_idx if padding_idx >= 0 else size[0] + padding_idx
-            self.weight.value = self.weight.value.at[pad].set(0.0)
+            self.weight.value = self.weight.value.at[self._padding_idx].set(0.0)
 
     def forward(self, input):
         return dispatch("lookup_table_v2", {"W": self.weight, "Ids": input},

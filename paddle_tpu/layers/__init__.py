@@ -26,7 +26,7 @@ from .control_flow import (  # noqa: F401
     not_equal,
 )
 from .sequence import *  # noqa: F401,F403
-from .io import data  # noqa: F401
+from .io import create_py_reader_by_data, data, double_buffer, py_reader, read_file  # noqa: F401
 from .layer_helper import LayerHelper, ParamAttr  # noqa: F401
 from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403

@@ -8,11 +8,12 @@ jax.device_put prefetch), since under XLA the graph itself doesn't own IO.
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Optional, Sequence
 
+from ..core import unique_name
 from ..core.framework import default_main_program, default_startup_program
 
-__all__ = ["data"]
+__all__ = ["data", "py_reader", "create_py_reader_by_data", "read_file", "double_buffer"]
 
 
 def data(name: str, shape: Sequence[int], dtype="float32", append_batch_size: bool = True,
@@ -30,3 +31,69 @@ def data(name: str, shape: Sequence[int], dtype="float32", append_batch_size: bo
     )
     var.lod_level = lod_level
     return var
+
+
+def py_reader(capacity: int, shapes: Sequence[Sequence[int]], dtypes: Sequence,
+              lod_levels: Optional[Sequence[int]] = None, name: Optional[str] = None,
+              use_double_buffer: bool = True):
+    """Async graph input (reference: python/paddle/fluid/layers/io.py:636).
+
+    Creates one data variable per (shape, dtype) and binds a PyReader whose
+    queue the Executor drains each step — see reader/py_reader.py for the
+    TPU-native design (host thread + device prefetch replaces the C++
+    blocking-queue `read` op).
+
+        reader = fluid.layers.py_reader(64, [[-1,784],[-1,1]], ['float32','int64'])
+        img, label = fluid.layers.read_file(reader)
+        ...
+        reader.decorate_paddle_reader(train_reader)
+        reader.start()
+        try:
+            while True: exe.run(fetch_list=[loss])
+        except fluid.core.EOFException:
+            reader.reset()
+    """
+    from ..reader.py_reader import PyReader
+
+    base = name or unique_name.generate("py_reader")
+    prog = default_main_program()
+    block = prog.global_block
+    vars_ = []
+    for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+        var = block.create_var(
+            name="%s_slot_%d" % (base, i),
+            shape=list(shape),
+            dtype=dtype,
+            is_data=True,
+            stop_gradient=True,
+        )
+        var.lod_level = (lod_levels[i] if lod_levels else 0)
+        vars_.append(var)
+    reader = PyReader(vars_, capacity, use_double_buffer=use_double_buffer, name=base)
+    prog._py_readers.append(reader)
+    return reader
+
+
+def create_py_reader_by_data(capacity: int, feed_list, name: Optional[str] = None,
+                             use_double_buffer: bool = True):
+    """Bind a PyReader to existing data variables (reference: io.py
+    create_py_reader_by_data)."""
+    from ..reader.py_reader import PyReader
+
+    prog = default_main_program()
+    reader = PyReader(list(feed_list), capacity, use_double_buffer=use_double_buffer,
+                      name=name or unique_name.generate("py_reader"))
+    prog._py_readers.append(reader)
+    return reader
+
+
+def read_file(reader):
+    """The data variables fed by a py_reader (reference: io.py read_file)."""
+    vars_ = reader.data_vars
+    return vars_[0] if len(vars_) == 1 else list(vars_)
+
+
+def double_buffer(reader, place=None, name=None):
+    """Compat shim: py_reader(use_double_buffer=True) already device-prefetches
+    (reader/prefetcher.py); returns the reader unchanged."""
+    return reader

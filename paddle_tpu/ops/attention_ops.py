@@ -44,6 +44,9 @@ def _flash_ok(q, k, causal) -> bool:
         return False
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    if causal and sq != sk:
+        # the kernel's causal masking assumes square q/k lengths
+        return False
     return sq % 128 == 0 and sk % 128 == 0 and q.dtype in (jnp.float32, jnp.bfloat16)
 
 
@@ -58,8 +61,21 @@ def sdpa(q, k, v, bias=None, segment_ids_q=None, segment_ids_kv=None,
             seg = SegmentIds(q=segment_ids_q, kv=segment_ids_kv)
         try:
             return flash(q, k, v, ab=bias, segment_ids=seg, causal=causal, sm_scale=sm_scale)
-        except Exception:
-            pass  # fall back to the composed path below
+        except Exception as e:
+            # A failed flash call means a ~S² perf regression — never hide it.
+            from ..flags import get_flag
+
+            if get_flag("strict_fused_attention"):
+                raise RuntimeError(
+                    "Pallas flash-attention failed for shapes q=%s k=%s "
+                    "(causal=%s): %s" % (q.shape, k.shape, causal, e)) from e
+            import warnings
+
+            warnings.warn(
+                "Pallas flash-attention failed (%s: %s); falling back to the "
+                "composed O(S^2) attention. Set FLAGS_strict_fused_attention=1 "
+                "to make this an error." % (type(e).__name__, e),
+                RuntimeWarning, stacklevel=2)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm_scale
     if bias is not None:
         scores = scores + bias

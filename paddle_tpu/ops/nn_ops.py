@@ -52,6 +52,20 @@ def cross_entropy_op(ctx: OpContext):
     )
 
 
+def _fused_xent_ok(logits) -> bool:
+    """Use the Pallas kernel on TPU for 2D+ float logits with a wide vocab
+    (small vocabs gain nothing over the XLA fusion)."""
+    if jax.default_backend() in ("cpu", "gpu"):
+        return False
+    from .pallas_kernels import softmax_xent_supported
+
+    n = 1
+    for d in logits.shape[:-1]:
+        n *= int(d)
+    return (logits.ndim >= 2 and logits.shape[-1] >= 4096
+            and softmax_xent_supported(n, logits.shape[-1], logits.dtype))
+
+
 @register_op("softmax_with_cross_entropy")
 def softmax_with_cross_entropy_op(ctx: OpContext):
     """One log_softmax pass serves plain CE, soft labels, AND label
@@ -64,6 +78,25 @@ def softmax_with_cross_entropy_op(ctx: OpContext):
     soft_label = ctx.attr("soft_label", False)
     smooth = float(ctx.attr("label_smoothing", 0.0) or 0.0)
     out_dtype = logits.dtype
+    if (not soft_label and not smooth
+            and ctx.attr("ignore_index", -100) == -100
+            and _fused_xent_ok(logits)):
+        # Pallas fused path (pallas_kernels/softmax_xent.py): forward writes
+        # only O(N) outputs; backward computes softmax-onehot on the fly.
+        from .pallas_kernels import fused_softmax_xent
+
+        v = logits.shape[-1]
+        lead = logits.shape[:-1]
+        lbl2d = label.reshape(-1, 1)
+        loss = fused_softmax_xent(logits.reshape(-1, v), lbl2d)
+        ctx.set_output("Loss", loss.reshape(*lead, 1).astype(out_dtype))
+        if ctx.has_output("Softmax"):
+            # derived lazily (reference grad kernel also treats Softmax as a
+            # value, not a grad path); dead unless consumed, then XLA DCEs it
+            f32 = logits.astype(jnp.float32)
+            sm = jnp.exp(f32 - jax.scipy.special.logsumexp(f32, axis=-1, keepdims=True))
+            ctx.set_output("Softmax", jax.lax.stop_gradient(sm).astype(out_dtype))
+        return
     log_p = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     if soft_label:
         loss = -jnp.sum(label * log_p, axis=-1, keepdims=True)

@@ -1,0 +1,14 @@
+"""Builder-written Pallas TPU kernels for ops where XLA's default lowering
+underperforms (the role of the reference's hand-tuned ``operators/jit/`` —
+7.2k LoC of JIT-assembled CPU kernels for hot ops).
+
+Kernels:
+- softmax_xent: fused softmax + cross-entropy over large vocab
+  (forward never materializes the [N, V] probabilities in HBM).
+
+Each kernel has an XLA-composed reference implementation it is numerically
+tested against, and ``benchmarks/bench_softmax_xent.py`` measures the win on
+real TPU hardware.
+"""
+
+from .softmax_xent import fused_softmax_xent, softmax_xent_supported  # noqa: F401

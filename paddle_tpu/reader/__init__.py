@@ -10,3 +10,4 @@ from .decorator import (  # noqa: F401
     xmap_readers,
 )
 from .prefetcher import DevicePrefetcher  # noqa: F401
+from .py_reader import EOFException, PyReader  # noqa: F401

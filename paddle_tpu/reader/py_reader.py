@@ -1,0 +1,147 @@
+"""py_reader: async, double-buffered graph input.
+
+Reference: ``python/paddle/fluid/layers/io.py:636 py_reader`` +
+``operators/reader/buffered_reader.cc`` + the LoDTensorBlockingQueue. The
+reference's design is a C++ blocking queue drained by a ``read`` op inside
+the graph; the TPU-native equivalent keeps the graph pure — the Executor
+drains the queue at step boundaries and feeds the arrays as ordinary jit
+args, while a background thread (plus DevicePrefetcher when
+``use_double_buffer``) converts and device_puts the NEXT batch during the
+current step. Same UX: ``start()`` / step until ``EOFException`` /
+``reset()``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["PyReader", "EOFException"]
+
+
+class EOFException(Exception):
+    """Raised by Executor.run when a started py_reader is exhausted
+    (reference: fluid.core.EOFException from the read op)."""
+
+
+class PyReader:
+    """Queue-backed reader bound to a set of data variables.
+
+    Created via ``fluid.layers.py_reader``; the Executor pulls one batch per
+    ``run`` for the reader's variables when no explicit feed provides them.
+    """
+
+    _END = object()
+
+    def __init__(self, data_vars, capacity: int, use_double_buffer: bool = True,
+                 name: Optional[str] = None):
+        self.data_vars = list(data_vars)
+        self.var_names = [v.name for v in self.data_vars]
+        self.capacity = int(capacity)
+        self.use_double_buffer = use_double_buffer
+        self.name = name
+        self._source: Optional[Callable] = None
+        self._q: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._err = None
+        self._started = False
+        self._gen = 0  # incremented by reset() so stale workers die
+
+    # -- decoration (reference: py_reader.decorate_paddle_reader) -------------
+    def decorate_paddle_reader(self, reader: Callable, places=None):
+        """``reader()`` yields batches as lists of per-sample tuples (the
+        output of paddle.batch); samples are stacked per slot."""
+
+        def gen():
+            for batch in reader():
+                slots = list(zip(*batch))
+                yield tuple(np.asarray(np.stack(s)) for s in slots)
+
+        self._source = gen
+
+    def decorate_tensor_provider(self, reader: Callable, places=None):
+        """``reader()`` yields tuples of ready batch arrays, one per var."""
+
+        def gen():
+            for batch in reader():
+                yield tuple(np.asarray(a) for a in batch)
+
+        self._source = gen
+
+    decorate_batch_generator = decorate_tensor_provider
+
+    def decorate_sample_list_generator(self, reader: Callable, places=None):
+        self.decorate_paddle_reader(reader, places)
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self):
+        if self._source is None:
+            raise RuntimeError(
+                "py_reader has no data source; call decorate_paddle_reader / "
+                "decorate_tensor_provider first")
+        if self._started:
+            raise RuntimeError("py_reader already started; call reset() between epochs")
+        self._q = queue.Queue(maxsize=self.capacity)
+        self._err = None
+        self._started = True
+        gen_token = self._gen
+
+        def worker(q=self._q, token=gen_token):
+            try:
+                it = self._source()
+                if self.use_double_buffer:
+                    from .prefetcher import DevicePrefetcher
+
+                    it = DevicePrefetcher(
+                        ({n: a for n, a in zip(self.var_names, batch)} for batch in it),
+                        capacity=2)
+                    for feed in it:
+                        if self._gen != token:
+                            return
+                        q.put(tuple(feed[n] for n in self.var_names))
+                else:
+                    for batch in it:
+                        if self._gen != token:
+                            return
+                        q.put(batch)
+            except Exception as e:
+                self._err = e
+            finally:
+                q.put(self._END)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        """Stop the current pass (after EOF or mid-epoch) so start() can be
+        called again (reference: reader->ReInit())."""
+        self._gen += 1
+        self._started = False
+        q = self._q
+        if q is not None:
+            while True:  # drain so a blocked worker can exit
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+        self._q = None
+
+    # -- executor hook --------------------------------------------------------
+    def next_feed(self) -> dict:
+        """One batch as {var_name: array}; EOFException when exhausted."""
+        if not self._started:
+            raise RuntimeError("py_reader not started; call reader.start()")
+        item = self._q.get()
+        if item is self._END:
+            self._started = False
+            if self._err is not None:
+                raise self._err
+            raise EOFException("py_reader %r exhausted" % (self.name or "py_reader"))
+        if len(item) != len(self.var_names):
+            raise ValueError(
+                "py_reader produced %d arrays per batch but is bound to %d "
+                "variables %s" % (len(item), len(self.var_names), self.var_names))
+        return dict(zip(self.var_names, item))

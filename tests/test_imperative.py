@@ -251,6 +251,36 @@ def test_bn_stats_are_not_parameters():
         assert len(names) == 2, f"BN must expose scale+bias only, got {names}"
 
 
+def test_bn_eval_mode_uses_running_stats(rng):
+    """Layer.eval() must switch BN to running stats and freeze them."""
+    x = rng.randn(8, 4, 6, 6).astype("float32") * 3 + 1
+    with imperative.guard():
+        bn = imperative.BatchNorm("bn", num_channels=4)
+        bn(to_variable(x))  # one train step seeds running stats
+        mean_after_train = bn._mean.numpy().copy()
+        bn.eval()
+        out = bn(to_variable(x))
+        np.testing.assert_array_equal(bn._mean.numpy(), mean_after_train)
+        # eval output must use running stats, not batch stats (batch stats
+        # would give per-channel mean ~0)
+        ch_mean = np.abs(out.numpy().mean(axis=(0, 2, 3))).max()
+        assert ch_mean > 0.05, "eval-mode BN normalized with batch statistics"
+        bn.train()
+        bn(to_variable(x))
+        assert not np.allclose(bn._mean.numpy(), mean_after_train)
+
+
+def test_embedding_negative_padding_idx_masks_grad(rng):
+    with imperative.guard():
+        emb = imperative.Embedding("emb", size=[10, 3], padding_idx=-1)
+        ids = to_variable(np.array([[9, 1]], dtype="int64"))
+        loss = F.mean(emb(ids))
+        loss.backward()
+        g = emb.weight.gradient()
+        np.testing.assert_array_equal(g[9], np.zeros(3, "float32"))
+        assert np.abs(g[1]).sum() > 0
+
+
 def test_imperative_adam_state_persists(rng):
     """Accumulators (moments) must persist across minimize calls."""
     with imperative.guard():

@@ -1,0 +1,118 @@
+"""Builder-written Pallas kernel tests (interpret mode on CPU) + fused-path
+gating and the no-silent-fallback contract for flash attention."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import attention_ops
+from paddle_tpu.ops.pallas_kernels import fused_softmax_xent
+
+
+def _ref_loss(logits, labels):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(logp, labels.astype(jnp.int32), axis=-1)
+
+
+@pytest.mark.parametrize("n,v", [(32, 1000), (64, 4096), (17, 300), (8, 128)])
+def test_fused_softmax_xent_forward_parity(rng, n, v):
+    logits = jnp.asarray(rng.randn(n, v).astype("float32") * 3)
+    labels = jnp.asarray(rng.randint(0, v, (n, 1)).astype("int32"))
+    loss = fused_softmax_xent(logits, labels, True)
+    np.testing.assert_allclose(loss, _ref_loss(logits, labels), rtol=2e-5, atol=2e-5)
+
+
+def test_fused_softmax_xent_grad_parity(rng):
+    n, v = 24, 1536
+    logits = jnp.asarray(rng.randn(n, v).astype("float32"))
+    labels = jnp.asarray(rng.randint(0, v, (n, 1)).astype("int32"))
+    w = jnp.asarray(rng.randn(n, 1).astype("float32"))  # non-uniform cotangent
+    g1 = jax.grad(lambda x: (fused_softmax_xent(x, labels, True) * w).sum())(logits)
+    g2 = jax.grad(lambda x: (_ref_loss(x, labels) * w).sum())(logits)
+    np.testing.assert_allclose(g1, g2, rtol=2e-4, atol=1e-5)
+
+
+def test_fused_softmax_xent_bf16(rng):
+    n, v = 16, 512
+    logits = jnp.asarray(rng.randn(n, v).astype("float32")).astype(jnp.bfloat16)
+    labels = jnp.asarray(rng.randint(0, v, (n, 1)).astype("int32"))
+    loss = fused_softmax_xent(logits, labels, True)
+    ref = _ref_loss(logits, labels)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref), rtol=2e-2, atol=2e-2)
+    g = jax.grad(lambda x: fused_softmax_xent(x, labels, True).sum())(logits)
+    assert g.dtype == jnp.bfloat16
+
+
+def test_fused_gate_is_tpu_only():
+    """On CPU the op must keep the composed XLA path (interpret-mode pallas
+    would crawl); the gate also rejects tiny vocabs."""
+    from paddle_tpu.ops.nn_ops import _fused_xent_ok
+
+    assert jax.default_backend() == "cpu"
+    assert not _fused_xent_ok(jnp.zeros((32, 32768)))
+
+
+# -- flash-attention fallback contract ---------------------------------------
+
+
+def _mk_qkv(rng, s=256, d=64):
+    q = jnp.asarray(rng.randn(2, 4, s, d).astype("float32"))
+    return q, q + 0.1, q + 0.2
+
+
+def test_flash_failure_warns_not_silent(rng, monkeypatch):
+    """A failing Pallas flash call must emit a RuntimeWarning, not vanish."""
+    q, k, v = _mk_qkv(rng)
+
+    def boom(*a, **kw):
+        raise ValueError("synthetic pallas failure")
+
+    monkeypatch.setattr(attention_ops, "_on_tpu", lambda: True)
+    monkeypatch.setattr(attention_ops, "_flash_fn", lambda: (boom, None))
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        out = attention_ops.sdpa(q, k, v)
+    assert out.shape == q.shape
+
+
+def test_flash_failure_strict_mode_raises(rng, monkeypatch):
+    from paddle_tpu.flags import set_flag
+
+    q, k, v = _mk_qkv(rng)
+
+    def boom(*a, **kw):
+        raise ValueError("synthetic pallas failure")
+
+    monkeypatch.setattr(attention_ops, "_on_tpu", lambda: True)
+    monkeypatch.setattr(attention_ops, "_flash_fn", lambda: (boom, None))
+    set_flag("strict_fused_attention", True)
+    try:
+        with pytest.raises(RuntimeError, match="flash-attention failed"):
+            attention_ops.sdpa(q, k, v)
+    finally:
+        set_flag("strict_fused_attention", False)
+
+
+def test_flash_path_taken_when_gates_pass(rng, monkeypatch):
+    """When on 'TPU' with clean shapes, sdpa must call the flash kernel."""
+    q, k, v = _mk_qkv(rng)
+    called = {}
+
+    def fake_flash(q, k, v, ab=None, segment_ids=None, causal=False, sm_scale=1.0):
+        called["yes"] = True
+        return q
+
+    monkeypatch.setattr(attention_ops, "_on_tpu", lambda: True)
+    monkeypatch.setattr(attention_ops, "_flash_fn", lambda: (fake_flash, None))
+    attention_ops.sdpa(q, k, v, causal=True)
+    assert called.get("yes"), "flash path not taken despite passing gates"
+
+
+def test_flash_gate_rejects_causal_rectangular(rng, monkeypatch):
+    monkeypatch.setattr(attention_ops, "_on_tpu", lambda: True)
+    q = jnp.zeros((2, 4, 128, 64))
+    k = jnp.zeros((2, 4, 256, 64))
+    assert not attention_ops._flash_ok(q, k, causal=True)
+    assert attention_ops._flash_ok(q, k, causal=False) or attention_ops._flash_fn()[0] is None
