@@ -1,4 +1,24 @@
-from . import attention, beam_search as beam_search_mod, control_flow, io, learning_rate_scheduler, nn, rnn, sequence, tensor  # noqa: F401
+from . import attention, beam_search as beam_search_mod, control_flow, detection, io, learning_rate_scheduler, nn, rnn, sequence, tensor  # noqa: F401
+from .detection import (  # noqa: F401
+    anchor_generator,
+    bipartite_match,
+    box_clip,
+    box_coder,
+    density_prior_box,
+    detection_output,
+    generate_proposals,
+    iou_similarity,
+    mine_hard_examples,
+    multi_box_head,
+    multiclass_nms,
+    polygon_box_transform,
+    prior_box,
+    roi_align,
+    roi_pool,
+    ssd_loss,
+    target_assign,
+    yolov3_loss,
+)
 from .beam_search import (  # noqa: F401
     array_length,
     array_read,

@@ -6,6 +6,7 @@ from . import (  # noqa: F401
     beam_search_ops,
     compare_ops,
     control_flow_ops,
+    detection_ops,
     math_ops,
     nn_ops,
     optimizer_ops,

@@ -1,0 +1,391 @@
+"""Detection op/layer tests, each against an independent numpy reference
+(modeling the reference's unittests: test_multiclass_nms_op.py,
+test_bipartite_match_op.py, test_box_coder_op.py, test_prior_box_op.py,
+test_roi_align_op (torchvision-style), test_yolov3_loss_op.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.layers import detection
+
+
+def _run(fetch, feed=None):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe.run(feed=feed or {}, fetch_list=fetch if isinstance(fetch, list) else [fetch])
+
+
+def _np_iou(a, b):
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = np.clip(a[:, 2] - a[:, 0], 0, None) * np.clip(a[:, 3] - a[:, 1], 0, None)
+    area_b = np.clip(b[:, 2] - b[:, 0], 0, None) * np.clip(b[:, 3] - b[:, 1], 0, None)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+
+def test_iou_similarity(rng):
+    a = np.sort(rng.rand(5, 4).astype("float32"), -1)[:, [0, 2, 1, 3]]
+    b = np.sort(rng.rand(7, 4).astype("float32"), -1)[:, [0, 2, 1, 3]]
+    x = fluid.layers.data("x", shape=[4], append_batch_size=True)
+    y = fluid.layers.data("y", shape=[4])
+    out = detection.iou_similarity(x, y)
+    got, = _run(out, {"x": a, "y": b})
+    np.testing.assert_allclose(got, _np_iou(a, b), rtol=1e-5, atol=1e-6)
+
+
+def test_box_coder_encode_decode_roundtrip(rng):
+    priors = np.array([[0.1, 0.1, 0.5, 0.5], [0.2, 0.3, 0.7, 0.9]], "float32")
+    pvar = np.array([[0.1, 0.1, 0.2, 0.2]] * 2, "float32")
+    targets = np.array([[0.15, 0.2, 0.6, 0.8], [0.05, 0.05, 0.4, 0.5],
+                        [0.3, 0.3, 0.8, 0.85]], "float32")
+    pb = fluid.layers.data("pb", shape=[4])
+    pv = fluid.layers.data("pv", shape=[4])
+    tb = fluid.layers.data("tb", shape=[4])
+    enc = detection.box_coder(pb, pv, tb, code_type="encode_center_size")
+    # encode output [N, M, 4] has priors along dim 1 → decode axis=0
+    dec = detection.box_coder(pb, pv, enc, code_type="decode_center_size", axis=0)
+    e, d = _run([enc, dec], {"pb": priors, "pv": pvar, "tb": targets})
+    assert e.shape == (3, 2, 4)
+    # decode(encode(t)) must give t back for every prior column
+    for j in range(2):
+        np.testing.assert_allclose(d[:, j], targets, rtol=1e-4, atol=1e-5)
+
+
+def test_prior_box_matches_manual(rng):
+    feat = rng.randn(1, 8, 4, 4).astype("float32")
+    img = rng.randn(1, 3, 32, 32).astype("float32")
+    f = fluid.layers.data("f", shape=[8, 4, 4])
+    im = fluid.layers.data("im", shape=[3, 32, 32])
+    boxes, var = detection.prior_box(f, im, min_sizes=[8.0], max_sizes=[16.0],
+                                     aspect_ratios=[2.0], flip=True, clip=True)
+    b, v = _run([boxes, var], {"f": feat, "im": img})
+    # priors per cell: min, ar=2, ar=0.5, sqrt(min*max) => 4
+    assert b.shape == (4, 4, 4, 4) and v.shape == b.shape
+    # center of cell (0,0): step 8 → center (4,4); min box half-size 4px
+    np.testing.assert_allclose(b[0, 0, 0], [0.0, 0.0, 8 / 32, 8 / 32], atol=1e-6)
+    big = np.sqrt(8.0 * 16.0) / 2
+    np.testing.assert_allclose(
+        b[0, 0, 3], np.clip([(4 - big) / 32, (4 - big) / 32, (4 + big) / 32, (4 + big) / 32], 0, 1),
+        atol=1e-5)
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2], atol=1e-6)
+    assert (b >= 0).all() and (b <= 1).all()
+
+
+def test_anchor_generator_shapes_and_values(rng):
+    f = fluid.layers.data("f", shape=[8, 2, 3])
+    anchors, var = detection.anchor_generator(
+        f, anchor_sizes=[32.0, 64.0], aspect_ratios=[1.0], stride=[16.0, 16.0])
+    a, v = _run([anchors, var], {"f": np.zeros((1, 8, 2, 3), "float32")})
+    assert a.shape == (2, 3, 2, 4)
+    # cell (0,0) center = (8, 8), size-32 square anchor
+    np.testing.assert_allclose(a[0, 0, 0], [-8.0, -8.0, 24.0, 24.0], atol=1e-4)
+
+
+def test_bipartite_match_greedy(rng):
+    # hand-crafted: row0 best col1 (0.9), row1 best col0 (0.8)
+    dist = np.array([[[0.3, 0.9, 0.1],
+                      [0.8, 0.7, 0.2]]], "float32")
+    d = fluid.layers.data("d", shape=[2, 3])
+    idx, md = detection.bipartite_match(d)
+    i, m = _run([idx, md], {"d": dist})
+    np.testing.assert_array_equal(i[0], [1, 0, -1])
+    np.testing.assert_allclose(m[0], [0.8, 0.9, 0.0], atol=1e-6)
+
+
+def test_bipartite_match_per_prediction(rng):
+    dist = np.array([[[0.3, 0.9, 0.6],
+                      [0.8, 0.7, 0.2]]], "float32")
+    d = fluid.layers.data("d", shape=[2, 3])
+    idx, md = detection.bipartite_match(d, match_type="per_prediction",
+                                        dist_threshold=0.5)
+    i, m = _run([idx, md], {"d": dist})
+    # col2 unmatched by bipartite phase; its argmax row is 0 with 0.6 >= 0.5
+    np.testing.assert_array_equal(i[0], [1, 0, 0])
+
+
+def test_target_assign_per_column_gather(rng):
+    x = rng.randn(1, 2, 4, 3).astype("float32")  # [B, Ng, P, K]
+    match = np.array([[1, -1, 0, 1]], "int32")   # M=4, P=4
+    xv = fluid.layers.data("x", shape=[2, 4, 3])
+    mv = fluid.layers.data("m", shape=[4], dtype="int32")
+    out, w = detection.target_assign(xv, mv, mismatch_value=0)
+    o, wt = _run([out, w], {"x": x, "m": match})
+    np.testing.assert_allclose(o[0, 0], x[0, 1, 0], rtol=1e-6)
+    np.testing.assert_allclose(o[0, 2], x[0, 0, 2], rtol=1e-6)
+    np.testing.assert_array_equal(o[0, 1], np.zeros(3, "float32"))
+    np.testing.assert_allclose(wt[0, :, 0], [1, 0, 1, 1])
+
+
+def _np_nms(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    keep = []
+    for i in order:
+        if scores[i] == -np.inf:
+            continue
+        ok = True
+        for j in keep:
+            if _np_iou(boxes[i:i + 1], boxes[j:j + 1])[0, 0] > thresh:
+                ok = False
+                break
+        if ok:
+            keep.append(i)
+    return keep
+
+
+def test_multiclass_nms_matches_numpy(rng):
+    np_boxes = np.sort(rng.rand(1, 20, 4).astype("float32"), -1)[:, :, [0, 2, 1, 3]]
+    np_scores = rng.rand(1, 3, 20).astype("float32")
+    bb = fluid.layers.data("bb", shape=[20, 4])
+    sc = fluid.layers.data("sc", shape=[3, 20])
+    out, length = detection.multiclass_nms(
+        bb, sc, score_threshold=0.3, nms_top_k=10, keep_top_k=5,
+        nms_threshold=0.4, background_label=0, return_length=True)
+    o, ln = _run([out, length], {"bb": np_boxes, "sc": np_scores})
+
+    # numpy reference
+    cand = []
+    for c in (1, 2):
+        s = np_scores[0, c].copy()
+        s[s <= 0.3] = -np.inf
+        top = np.argsort(-s)[:10]
+        sel_s = np.where(np.isin(np.arange(20), top), s, -np.inf)
+        keep = _np_nms(np_boxes[0], sel_s, 0.4)
+        cand += [(c, s[i], np_boxes[0, i]) for i in keep if s[i] > -np.inf]
+    cand.sort(key=lambda t: -t[1])
+    cand = cand[:5]
+    assert int(ln[0]) == len(cand)
+    got = o[0][:len(cand)]
+    exp = np.array([[c, s, *b] for c, s, b in cand], "float32")
+    # order of equal scores may differ; sort both by score desc then label
+    np.testing.assert_allclose(
+        got[np.lexsort((got[:, 0], -got[:, 1]))],
+        exp[np.lexsort((exp[:, 0], -exp[:, 1]))], rtol=1e-4, atol=1e-5)
+    # padding rows are -1
+    assert (o[0][len(cand):] == -1).all()
+
+
+def test_box_clip(rng):
+    boxes = np.array([[[-5.0, -3.0, 40.0, 50.0]]], "float32")
+    info = np.array([[32.0, 24.0, 1.0]], "float32")  # h=32, w=24
+    b = fluid.layers.data("b", shape=[1, 4])
+    im = fluid.layers.data("im", shape=[3])
+    out = detection.box_clip(b, im)
+    got, = _run(out, {"b": boxes, "im": info})
+    np.testing.assert_allclose(got[0, 0], [0.0, 0.0, 23.0, 31.0])
+
+
+def _np_roi_align(feat, roi, ph, pw, scale, s=2):
+    c, h, w = feat.shape
+    x1, y1, x2, y2 = roi * scale
+    rw = max(x2 - x1, 1e-6)
+    rh = max(y2 - y1, 1e-6)
+    bw, bh = rw / pw, rh / ph
+    out = np.zeros((c, ph, pw), "float32")
+    for i in range(ph):
+        for j in range(pw):
+            acc = np.zeros(c, "float32")
+            for si in range(s):
+                for sj in range(s):
+                    yy = min(max(y1 + i * bh + (si + 0.5) * bh / s, 0), h - 1)
+                    xx = min(max(x1 + j * bw + (sj + 0.5) * bw / s, 0), w - 1)
+                    y0, x0 = int(np.floor(yy)), int(np.floor(xx))
+                    y1i, x1i = min(y0 + 1, h - 1), min(x0 + 1, w - 1)
+                    ly, lx = yy - y0, xx - x0
+                    acc += (feat[:, y0, x0] * (1 - ly) * (1 - lx)
+                            + feat[:, y0, x1i] * (1 - ly) * lx
+                            + feat[:, y1i, x0] * ly * (1 - lx)
+                            + feat[:, y1i, x1i] * ly * lx)
+            out[:, i, j] = acc / (s * s)
+    return out
+
+
+def test_roi_align_matches_numpy(rng):
+    feat = rng.randn(2, 3, 16, 16).astype("float32")
+    rois = np.array([[2.0, 2.0, 12.0, 10.0], [0.0, 0.0, 30.0, 30.0]], "float32")
+    bids = np.array([0, 1], "int32")
+    x = fluid.layers.data("x", shape=[3, 16, 16])
+    r = fluid.layers.data("r", shape=[4])
+    bi = fluid.layers.data("bi", shape=[], dtype="int32")
+    out = detection.roi_align(x, r, pooled_height=4, pooled_width=4,
+                              spatial_scale=0.5, sampling_ratio=2, batch_id=bi)
+    got, = _run(out, {"x": feat, "r": rois, "bi": bids})
+    for k in range(2):
+        exp = _np_roi_align(feat[bids[k]], rois[k], 4, 4, 0.5)
+        np.testing.assert_allclose(got[k], exp, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_pool_max_semantics(rng):
+    feat = np.arange(36, dtype="float32").reshape(1, 1, 6, 6)
+    rois = np.array([[0.0, 0.0, 5.0, 5.0]], "float32")
+    x = fluid.layers.data("x", shape=[1, 6, 6])
+    r = fluid.layers.data("r", shape=[4])
+    out = detection.roi_pool(x, r, pooled_height=2, pooled_width=2, spatial_scale=1.0)
+    got, = _run(out, {"x": feat, "r": rois})
+    np.testing.assert_allclose(got[0, 0], [[14.0, 17.0], [32.0, 35.0]])
+
+
+def test_polygon_box_transform():
+    x_in = np.ones((1, 8, 2, 2), "float32")
+    x = fluid.layers.data("x", shape=[8, 2, 2])
+    out = detection.polygon_box_transform(x)
+    got, = _run(out, {"x": x_in})
+    # even channels: 4*id_w - 1; odd channels: 4*id_h - 1
+    np.testing.assert_allclose(got[0, 0], [[-1.0, 3.0], [-1.0, 3.0]])
+    np.testing.assert_allclose(got[0, 1], [[-1.0, -1.0], [3.0, 3.0]])
+
+
+def test_generate_proposals_smoke(rng):
+    b, a, h, w = 1, 3, 4, 4
+    scores = rng.rand(b, a, h, w).astype("float32")
+    deltas = (rng.randn(b, 4 * a, h, w) * 0.1).astype("float32")
+    info = np.array([[64.0, 64.0, 1.0]], "float32")
+    sc = fluid.layers.data("sc", shape=[a, h, w])
+    dl = fluid.layers.data("dl", shape=[4 * a, h, w])
+    im = fluid.layers.data("im", shape=[3])
+    fv = fluid.layers.data("fv", shape=[a * 2, h, w])
+    anchors, variances = detection.anchor_generator(
+        fv, anchor_sizes=[16.0], aspect_ratios=[0.5, 1.0, 2.0], stride=[16.0, 16.0])
+    rois, probs, length = detection.generate_proposals(
+        sc, dl, im, anchors, variances, pre_nms_top_n=30, post_nms_top_n=10,
+        nms_thresh=0.7, min_size=2.0, return_length=True)
+    r, p, ln = _run([rois, probs, length],
+                    {"sc": scores, "dl": deltas, "im": info,
+                     "fv": np.zeros((1, a * 2, h, w), "float32")})
+    assert r.shape == (1, 10, 4) and p.shape == (1, 10, 1)
+    n = int(ln[0])
+    assert 0 < n <= 10
+    valid = r[0, :n]
+    assert (valid[:, 0] >= 0).all() and (valid[:, 2] <= 63.0 + 1e-4).all()
+    assert (valid[:, 2] - valid[:, 0] + 1 >= 2.0 - 1e-4).all()
+    assert (r[0, n:] == -1).all()
+
+
+def test_yolov3_loss_sanity(rng):
+    """Perfect prediction ⇒ much smaller loss than random; padded gts ignored."""
+    n, c, hgrid = 1, 4, 2
+    anchors = [10, 14, 23, 27, 37, 58]
+    mask = [0, 1, 2]
+    na = len(mask)
+    down = 32
+    # one gt in cell (0, 0), best anchor index 1 (w≈23/64, h≈27/64)
+    gt = np.zeros((n, 3, 4), "float32")
+    gt[0, 0] = [0.2, 0.2, 23 / 64.0, 27 / 64.0]
+    lab = np.zeros((n, 3), "int32")
+    lab[0, 0] = 2
+
+    def make_x(perfect):
+        x = np.zeros((n, na * (5 + c), hgrid, hgrid), "float32")
+        x5 = x.reshape(n, na, 5 + c, hgrid, hgrid)
+        if perfect:
+            sl, gi, gj = 1, 0, 0
+            # sigmoid(tx) = 0.4*2 - 0 = 0.4... cx*W - gi = 0.2*2 = 0.4
+            x5[0, sl, 0, gj, gi] = np.log(0.4 / 0.6)
+            x5[0, sl, 1, gj, gi] = np.log(0.4 / 0.6)
+            x5[0, sl, 2, gj, gi] = np.log((23 / 64.0) * 64 / 23)  # = 0
+            x5[0, sl, 3, gj, gi] = 0.0
+            x5[0, sl, 4] = -10.0
+            x5[0, sl, 4, gj, gi] = 10.0
+            x5[:, :, 4][x5[:, :, 4] == 0] = -10.0
+            x5[0, sl, 5 + 2, gj, gi] = 10.0
+            x5[0, sl, 5:5 + c][x5[0, sl, 5:5 + c] == 0] = -10.0
+            x5[:, [0, 2], 4] = -10.0
+        else:
+            x5[:] = rng.randn(*x5.shape) * 2
+        return x
+
+    xv = fluid.layers.data("x", shape=[na * (5 + c), hgrid, hgrid])
+    gb = fluid.layers.data("gb", shape=[3, 4])
+    gl = fluid.layers.data("gl", shape=[3], dtype="int32")
+    loss = detection.yolov3_loss(xv, gb, gl, anchors, mask, c, 0.7, down)
+    l_good, = _run(loss, {"x": make_x(True), "gb": gt, "gl": lab})
+    with fluid.scope_guard(fluid.Scope()):
+        pass
+    l_bad, = _run(loss, {"x": make_x(False), "gb": gt, "gl": lab})
+    assert l_good.shape == (1,)
+    # the loss floor is the soft-target BCE entropy of the xy offsets
+    # (H(0.4)·2·wgt ≈ 2.5) — same as the reference's sigmoid-CE formulation
+    assert float(l_good[0]) < float(l_bad[0]) * 0.5
+    assert float(l_good[0]) < 3.0
+
+
+def test_ssd_loss_end_to_end(rng):
+    """ssd_loss trains an SSD-style head: loss finite and decreases."""
+    b, p, c, ng = 2, 8, 3, 2
+    priors = np.sort(rng.rand(p, 4).astype("float32"), -1)[:, [0, 2, 1, 3]]
+    pvar = np.tile(np.array([0.1, 0.1, 0.2, 0.2], "float32"), (p, 1))
+    gts = np.sort(rng.rand(b, ng, 4).astype("float32"), -1)[:, :, [0, 2, 1, 3]]
+    gtl = rng.randint(1, c, (b, ng, 1)).astype("int64")
+
+    loc_v = fluid.layers.data("loc", shape=[p, 4])
+    conf_v = fluid.layers.data("conf", shape=[p, c])
+    gb = fluid.layers.data("gb", shape=[ng, 4])
+    gl = fluid.layers.data("gl", shape=[ng, 1], dtype="int64")
+    pb = fluid.layers.data("pb", shape=[4])
+    pv = fluid.layers.data("pv", shape=[4])
+    loss = detection.ssd_loss(loc_v, conf_v, gb, gl, pb, pv)
+    mean_loss = fluid.layers.mean(loss)
+    got, = _run(mean_loss, {
+        "loc": rng.randn(b, p, 4).astype("float32"),
+        "conf": rng.randn(b, p, c).astype("float32"),
+        "gb": gts, "gl": gtl, "pb": priors, "pv": pvar})
+    assert np.isfinite(got).all() and float(got) > 0
+
+
+def test_ssd_head_trains(rng):
+    """Tiny SSD: multi_box_head over two feature maps + ssd_loss, loss
+    decreases under SGD (the reference's book SSD config in miniature)."""
+    b, ng = 2, 2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        f1 = fluid.layers.data("f1", shape=[4, 8, 8])
+        f2 = fluid.layers.data("f2", shape=[4, 4, 4])
+        img = fluid.layers.data("img", shape=[3, 64, 64])
+        gb = fluid.layers.data("gb", shape=[ng, 4])
+        gl = fluid.layers.data("gl", shape=[ng, 1], dtype="int64")
+        locs, confs, boxes, vars_ = detection.multi_box_head(
+            [f1, f2], img, base_size=64, num_classes=3,
+            aspect_ratios=[[2.0], [2.0]], min_sizes=[8.0, 16.0],
+            max_sizes=[16.0, 32.0], flip=True, offset=0.5)
+        loss = fluid.layers.mean(
+            detection.ssd_loss(locs, confs, gb, gl, boxes, vars_))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {
+        "f1": rng.randn(b, 4, 8, 8).astype("float32"),
+        "f2": rng.randn(b, 4, 4, 4).astype("float32"),
+        "img": rng.randn(b, 3, 64, 64).astype("float32"),
+        "gb": np.sort(rng.rand(b, ng, 4).astype("float32"), -1)[:, :, [0, 2, 1, 3]],
+        "gl": rng.randint(1, 3, (b, ng, 1)).astype("int64"),
+    }
+    losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0]) for _ in range(8)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], f"SSD loss did not decrease: {losses}"
+
+
+def test_detection_output_pipeline(rng):
+    b, p, c = 1, 6, 3
+    priors = np.sort(rng.rand(p, 4).astype("float32"), -1)[:, [0, 2, 1, 3]]
+    pvar = np.tile(np.array([0.1, 0.1, 0.2, 0.2], "float32"), (p, 1))
+    loc = (rng.randn(b, p, 4) * 0.05).astype("float32")
+    conf = rng.randn(b, p, c).astype("float32")
+    lv = fluid.layers.data("loc", shape=[p, 4])
+    cv = fluid.layers.data("conf", shape=[p, c])
+    pb = fluid.layers.data("pb", shape=[4])
+    pv = fluid.layers.data("pv", shape=[4])
+    out, length = detection.detection_output(
+        lv, cv, pb, pv, nms_threshold=0.45, score_threshold=0.01,
+        nms_top_k=6, keep_top_k=4, return_length=True)
+    o, ln = _run([out, length],
+                 {"loc": loc, "conf": conf, "pb": priors, "pv": pvar})
+    assert o.shape == (1, 4, 6)
+    n = int(ln[0])
+    assert 0 <= n <= 4
+    if n:
+        assert (o[0, :n, 0] >= 1).all()  # labels skip background 0
+        assert ((o[0, :n, 1] >= 0) & (o[0, :n, 1] <= 1)).all()
