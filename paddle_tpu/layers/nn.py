@@ -1178,3 +1178,154 @@ def _pair(x):
 
 def _triple(x):
     return tuple(x) if isinstance(x, (list, tuple)) else (x, x, x)
+
+
+# -- structured-loss tail (ops/loss_ops.py) -----------------------------------
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    """CTC loss (reference: layers/nn.py warpctc → operators/warpctc_op.cc).
+    input [B, T, C] raw logits + input_length [B]; label [B, L] +
+    label_length [B] — padded+Length replacing the reference's LoD packing."""
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Logits": input, "Label": label}
+    if input_length is not None:
+        inputs["LogitsLength"] = input_length
+    if label_length is not None:
+        inputs["LabelLength"] = label_length
+    helper.append_op("warpctc", inputs=inputs, outputs={"Loss": loss},
+                     attrs={"blank": int(blank), "norm_by_times": norm_by_times})
+    return loss
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, name=None):
+    """Greedy CTC decode (reference: layers/nn.py ctc_greedy_decoder =
+    argmax + ctc_align). input [B, T, C] probs/logits → (decoded [B, T]
+    padded -1, length [B])."""
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    ids = argmax(input, axis=-1)
+    out = helper.create_variable_for_type_inference("int32")
+    out_len = helper.create_variable_for_type_inference("int32")
+    inputs = {"Input": ids}
+    if input_length is not None:
+        inputs["Length"] = input_length
+    helper.append_op("ctc_align", inputs=inputs,
+                     outputs={"Output": out, "OutputLength": out_len},
+                     attrs={"blank": int(blank)})
+    return out, out_len
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """reference: layers/nn.py linear_chain_crf. input [B, T, D] emissions +
+    length [B]; creates the [D+2, D] transition parameter."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    size = int(input.shape[-1])
+    transition = helper.create_parameter(
+        attr=helper.kwargs.get("param_attr"), shape=[size + 2, size], dtype=input.dtype)
+    ll = helper.create_variable_for_type_inference(input.dtype)
+    alpha = helper.create_variable_for_type_inference(input.dtype)
+    e_exps = helper.create_variable_for_type_inference(input.dtype)
+    t_exps = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Emission": input, "Transition": transition, "Label": label}
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op("linear_chain_crf", inputs=inputs,
+                     outputs={"LogLikelihood": ll, "Alpha": alpha,
+                              "EmissionExps": e_exps, "TransitionExps": t_exps})
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    """Viterbi decode with the trained transition param (reference:
+    layers/nn.py crf_decoding)."""
+    helper = LayerHelper("crf_decoding")
+    transition = helper.main_program.global_block.var(param_attr.name)
+    path = helper.create_variable_for_type_inference("int64")
+    inputs = {"Emission": input, "Transition": transition, "Label": label}
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op("crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": path})
+    return path
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """reference: layers/nn.py nce → operators/nce_op.cc."""
+    helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr, name=name)
+    dim = int(input.shape[-1])
+    w = helper.create_parameter(attr=helper.kwargs.get("param_attr"),
+                                shape=[num_total_classes, dim], dtype=input.dtype)
+    inputs = {"Input": input, "Weight": w, "Label": label}
+    if helper.kwargs.get("bias_attr") is not False:
+        b = helper.create_parameter(attr=helper.kwargs.get("bias_attr"),
+                                    shape=[num_total_classes], dtype=input.dtype,
+                                    is_bias=True)
+        inputs["Bias"] = b
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    s_logits = helper.create_variable_for_type_inference(input.dtype)
+    s_labels = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "nce", inputs=inputs,
+        outputs={"Cost": cost, "SampleLogits": s_logits, "SampleLabels": s_labels},
+        attrs={"num_total_classes": int(num_total_classes),
+               "num_neg_samples": int(num_neg_samples or 10), "seed": seed})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    """reference: layers/nn.py hsigmoid → hierarchical_sigmoid_op.cc
+    (default complete binary tree; custom trees via path_table unsupported —
+    raise rather than silently mis-train)."""
+    if is_custom or path_table is not None or path_code is not None:
+        raise NotImplementedError("custom-tree hsigmoid (path_table/path_code) "
+                                  "is not implemented")
+    helper = LayerHelper("hierarchical_sigmoid", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dim = int(input.shape[-1])
+    w = helper.create_parameter(attr=helper.kwargs.get("param_attr"),
+                                shape=[num_classes - 1, dim], dtype=input.dtype)
+    inputs = {"X": input, "W": w, "Label": label}
+    if helper.kwargs.get("bias_attr") is not False:
+        b = helper.create_parameter(attr=helper.kwargs.get("bias_attr"),
+                                    shape=[num_classes - 1], dtype=input.dtype,
+                                    is_bias=True)
+        inputs["Bias"] = b
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre_out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("hierarchical_sigmoid", inputs=inputs,
+                     outputs={"Out": out, "PreOut": pre_out},
+                     attrs={"num_classes": int(num_classes)})
+    return out
+
+
+def sample_logits(logits, label, num_samples, uniq=True,
+                  remove_accidental_hits=True, use_customized_samples=False,
+                  customized_samples=None, customized_probabilities=None,
+                  seed=0):
+    """Sampled-softmax helper (reference: operators/sample_logits_op.cc)."""
+    helper = LayerHelper("sample_logits")
+    samples = helper.create_variable_for_type_inference("int32")
+    probs = helper.create_variable_for_type_inference(logits.dtype)
+    s_logits = helper.create_variable_for_type_inference(logits.dtype)
+    s_labels = helper.create_variable_for_type_inference("int64")
+    inputs = {"Logits": logits, "Labels": label}
+    if use_customized_samples:
+        inputs["CustomizedSamples"] = customized_samples
+        inputs["CustomizedProbabilities"] = customized_probabilities
+    helper.append_op(
+        "sample_logits", inputs=inputs,
+        outputs={"Samples": samples, "Probabilities": probs,
+                 "SampledLogits": s_logits, "SampledLabels": s_labels},
+        attrs={"num_samples": int(num_samples), "uniq": uniq,
+               "remove_accidental_hits": remove_accidental_hits, "seed": seed})
+    return s_logits, s_labels
+
+
+__all__ += ["warpctc", "ctc_greedy_decoder", "linear_chain_crf", "crf_decoding",
+            "nce", "hsigmoid", "sample_logits"]

@@ -1,0 +1,252 @@
+"""Structured-loss tests: CTC against torch.nn.functional.ctc_loss,
+linear-chain CRF against brute-force enumeration, Viterbi against brute
+force, hsigmoid against a manual bit-code walk, NCE/sample_logits
+training sanity."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _run(fetch, feed):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe.run(feed=feed, fetch_list=fetch if isinstance(fetch, list) else [fetch])
+
+
+# -- CTC ----------------------------------------------------------------------
+
+
+def test_warpctc_matches_torch(rng):
+    torch = pytest.importorskip("torch")
+    b, t, c, l = 3, 12, 6, 4
+    logits = rng.randn(b, t, c).astype("float32")
+    labels = rng.randint(1, c, (b, l)).astype("int32")
+    in_lens = np.array([12, 10, 7], "int32")
+    lab_lens = np.array([4, 3, 2], "int32")
+
+    x = fluid.layers.data("x", shape=[t, c])
+    y = fluid.layers.data("y", shape=[l], dtype="int32")
+    il = fluid.layers.data("il", shape=[], dtype="int32")
+    ll = fluid.layers.data("ll", shape=[], dtype="int32")
+    loss = fluid.layers.warpctc(x, y, blank=0, input_length=il, label_length=ll)
+    got, = _run(loss, {"x": logits, "y": labels, "il": in_lens, "ll": lab_lens})
+
+    tl = torch.nn.functional.ctc_loss(
+        torch.log_softmax(torch.tensor(logits).permute(1, 0, 2), -1),
+        torch.tensor(labels.astype("int64")),
+        torch.tensor(in_lens.astype("int64")), torch.tensor(lab_lens.astype("int64")),
+        blank=0, reduction="none")
+    np.testing.assert_allclose(got[:, 0], tl.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_warpctc_gradient_flows(rng):
+    b, t, c, l = 2, 8, 5, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[t, c])
+        y = fluid.layers.data("y", shape=[l], dtype="int32")
+        h = fluid.layers.fc(x, size=c, num_flatten_dims=2)
+        loss = fluid.layers.mean(fluid.layers.warpctc(h, y, blank=0))
+        fluid.optimizer.Adam(2e-2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": rng.randn(b, t, c).astype("float32"),
+            "y": rng.randint(1, c, (b, l)).astype("int32")}
+    losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0]) for _ in range(15)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_ctc_greedy_decoder(rng):
+    # probs crafted so argmax path is [1,1,0,2,2,0,3] -> collapse to [1,2,3]
+    path = np.array([1, 1, 0, 2, 2, 0, 3])
+    t, c = len(path), 4
+    probs = np.full((1, t, c), 0.1, "float32")
+    probs[0, np.arange(t), path] = 0.9
+    x = fluid.layers.data("x", shape=[t, c])
+    out, ln = fluid.layers.ctc_greedy_decoder(x, blank=0)
+    o, n = _run([out, ln], {"x": probs})
+    assert int(n[0]) == 3
+    np.testing.assert_array_equal(o[0, :3], [1, 2, 3])
+    assert (o[0, 3:] == -1).all()
+
+
+# -- CRF ----------------------------------------------------------------------
+
+
+def _np_crf_nll(emission, transition, label, length):
+    """Brute-force -(path_score - logZ) per sequence."""
+    d = emission.shape[-1]
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    out = []
+    for em, lab, ln in zip(emission, label, length):
+        em = em[:ln]
+        lab = lab[:ln]
+        gold = start[lab[0]] + em[0, lab[0]] + stop[lab[-1]]
+        for k in range(1, ln):
+            gold += trans[lab[k - 1], lab[k]] + em[k, lab[k]]
+        z = -np.inf
+        for seq in itertools.product(range(d), repeat=ln):
+            s = start[seq[0]] + em[0, seq[0]] + stop[seq[-1]]
+            for k in range(1, ln):
+                s += trans[seq[k - 1], seq[k]] + em[k, seq[k]]
+            z = np.logaddexp(z, s)
+        out.append(-(gold - z))
+    return np.array(out, "float32")
+
+
+def test_linear_chain_crf_matches_bruteforce(rng):
+    b, t, d = 3, 4, 3
+    emission = rng.randn(b, t, d).astype("float32")
+    transition = (rng.randn(d + 2, d) * 0.5).astype("float32")
+    label = rng.randint(0, d, (b, t)).astype("int64")
+    length = np.array([4, 3, 2], "int32")
+
+    em = fluid.layers.data("em", shape=[t, d])
+    lb = fluid.layers.data("lb", shape=[t], dtype="int64")
+    ln = fluid.layers.data("ln", shape=[], dtype="int32")
+    ll = fluid.layers.linear_chain_crf(
+        em, lb, param_attr=fluid.ParamAttr(
+            name="crf_w", initializer=fluid.initializer.NumpyArrayInitializer(transition)),
+        length=ln)
+    got, = _run(ll, {"em": emission, "lb": label, "ln": length})
+    exp = _np_crf_nll(emission, transition, label, length)
+    np.testing.assert_allclose(got[:, 0], exp, rtol=1e-4, atol=1e-4)
+
+
+def test_crf_decoding_matches_bruteforce(rng):
+    b, t, d = 2, 4, 3
+    emission = rng.randn(b, t, d).astype("float32")
+    transition = (rng.randn(d + 2, d) * 0.5).astype("float32")
+    length = np.array([4, 3], "int32")
+
+    em = fluid.layers.data("em", shape=[t, d])
+    ln = fluid.layers.data("ln", shape=[], dtype="int32")
+    attr = fluid.ParamAttr(
+        name="crf_w2", initializer=fluid.initializer.NumpyArrayInitializer(transition))
+    lb = fluid.layers.data("lb", shape=[t], dtype="int64")
+    _ = fluid.layers.linear_chain_crf(em, lb, param_attr=attr, length=ln)
+    path = fluid.layers.crf_decoding(em, attr, length=ln)
+    got, = _run(path, {"em": emission, "ln": length,
+                       "lb": np.zeros((b, t), "int64")})
+
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    for i in range(b):
+        best, best_seq = -np.inf, None
+        for seq in itertools.product(range(d), repeat=int(length[i])):
+            s = start[seq[0]] + emission[i, 0, seq[0]] + stop[seq[-1]]
+            for k in range(1, len(seq)):
+                s += trans[seq[k - 1], seq[k]] + emission[i, k, seq[k]]
+            if s > best:
+                best, best_seq = s, seq
+        np.testing.assert_array_equal(got[i, :length[i]], best_seq)
+        assert (got[i, length[i]:] == 0).all()
+
+
+def test_crf_trains_sequence_tagging(rng):
+    """label_semantic_roles-style smoke: emissions + CRF train to lower cost."""
+    b, t, d = 8, 6, 4
+    xs = rng.randn(b, t, 8).astype("float32")
+    # learnable rule: tag = argmax of first 4 features
+    ys = xs[..., :4].argmax(-1).astype("int64")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[t, 8])
+        y = fluid.layers.data("y", shape=[t], dtype="int64")
+        em = fluid.layers.fc(x, size=d, num_flatten_dims=2)
+        cost = fluid.layers.mean(fluid.layers.linear_chain_crf(em, y))
+        fluid.optimizer.Adam(5e-2).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = [float(exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[cost])[0])
+              for _ in range(20)]
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+# -- hsigmoid -----------------------------------------------------------------
+
+
+def _np_hsigmoid(x, w, b, label, c):
+    out = np.zeros(len(x), "float32")
+    for i in range(len(x)):
+        code = int(label[i]) + c
+        length = code.bit_length() - 1
+        for bit in range(length):
+            idx = (code >> (bit + 1)) - 1
+            tgt = float((code >> bit) & 1)
+            logit = x[i] @ w[idx] + b[idx]
+            out[i] += max(logit, 0) - logit * tgt + np.log1p(np.exp(-abs(logit)))
+    return out
+
+
+def test_hsigmoid_matches_manual(rng):
+    bsz, d, c = 5, 6, 7
+    xs = rng.randn(bsz, d).astype("float32")
+    w0 = rng.randn(c - 1, d).astype("float32") * 0.3
+    b0 = rng.randn(c - 1).astype("float32") * 0.1
+    ys = rng.randint(0, c, (bsz, 1)).astype("int64")
+    x = fluid.layers.data("x", shape=[d])
+    y = fluid.layers.data("y", shape=[1], dtype="int64")
+    out = fluid.layers.hsigmoid(
+        x, y, c,
+        param_attr=fluid.ParamAttr(
+            name="hs_w", initializer=fluid.initializer.NumpyArrayInitializer(w0)),
+        bias_attr=fluid.ParamAttr(
+            name="hs_b", initializer=fluid.initializer.NumpyArrayInitializer(b0)))
+    got, = _run(out, {"x": xs, "y": ys})
+    np.testing.assert_allclose(got[:, 0], _np_hsigmoid(xs, w0, b0, ys[:, 0], c),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_hsigmoid_trains(rng):
+    bsz, d, c = 32, 8, 10
+    xs = rng.randn(bsz, d).astype("float32")
+    ys = (xs[:, :1] > 0).astype("int64")  # separable 2-of-10 classes
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[d])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        cost = fluid.layers.mean(fluid.layers.hsigmoid(x, y, c))
+        fluid.optimizer.Adam(5e-2).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = [float(exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[cost])[0])
+              for _ in range(20)]
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+# -- NCE / sample_logits ------------------------------------------------------
+
+
+def test_nce_trains_and_eval_deterministic(rng):
+    bsz, d, c = 16, 8, 20
+    xs = rng.randn(bsz, d).astype("float32")
+    ys = rng.randint(0, c, (bsz, 1)).astype("int64")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[d])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        cost = fluid.layers.mean(
+            fluid.layers.nce(x, y, num_total_classes=c, num_neg_samples=5))
+        fluid.optimizer.Adam(5e-2).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": xs, "y": ys}
+    losses = [float(exe.run(main, feed=feed, fetch_list=[cost])[0]) for _ in range(15)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_sample_logits_shapes_and_correction(rng):
+    bsz, c, nt, s = 4, 50, 1, 8
+    logits = rng.randn(bsz, c).astype("float32")
+    labels = rng.randint(0, c, (bsz, nt)).astype("int32")
+    lg = fluid.layers.data("lg", shape=[c])
+    lb = fluid.layers.data("lb", shape=[nt], dtype="int32")
+    s_logits, s_labels = fluid.layers.sample_logits(lg, lb, num_samples=s)
+    o, l = _run([s_logits, s_labels], {"lg": logits, "lb": labels})
+    assert o.shape == (bsz, nt + s)
+    np.testing.assert_array_equal(l, np.zeros((bsz, nt), "int64"))
+    assert np.isfinite(o[:, :nt]).all()
