@@ -21,6 +21,7 @@ from . import (  # noqa: F401
     amp,
     backward,
     clip,
+    contrib,
     dataset,
     debugger,
     imperative,

@@ -1,0 +1,6 @@
+"""Contrib namespace (reference: python/paddle/fluid/contrib/): quantization
+(QAT transpiler + fake-quant ops), with the reference's other contrib areas
+(slim, int8_inference, decoder) layered on the same primitives."""
+
+from . import quantize  # noqa: F401
+from .quantize import QuantizeTranspiler  # noqa: F401

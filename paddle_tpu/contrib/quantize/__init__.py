@@ -1,0 +1,1 @@
+from .quantize_transpiler import QuantizeTranspiler  # noqa: F401

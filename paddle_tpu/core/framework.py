@@ -202,6 +202,19 @@ class Operator:
     def attr(self, name: str, default=None):
         return self.attrs.get(name, default)
 
+    def _rename_input(self, old: str, new: str):
+        """Replace every occurrence of input var ``old`` with ``new``
+        (reference: framework.py Operator._rename_input; used by Program
+        rewrite passes like the quantize transpiler)."""
+        for slot, names in self.inputs.items():
+            self.inputs[slot] = [new if n == old else n for n in names]
+        self.block.program._version += 1
+
+    def _rename_output(self, old: str, new: str):
+        for slot, names in self.outputs.items():
+            self.outputs[slot] = [new if n == old else n for n in names]
+        self.block.program._version += 1
+
     def __repr__(self):
         ins = {k: v for k, v in self.inputs.items()}
         outs = {k: v for k, v in self.outputs.items()}
@@ -270,6 +283,26 @@ class Block:
         self.ops.insert(0, op)
         self.program._version += 1
         return op
+
+    def insert_op(self, index: int, type: str, inputs=None, outputs=None,
+                  attrs=None) -> Operator:
+        """Insert an op at position ``index`` (reference: block._insert_op —
+        the primitive Program-rewrite passes build on)."""
+        op = Operator(self, type, inputs=inputs, outputs=outputs, attrs=attrs)
+        self.ops.insert(index, op)
+        for slot in op.outputs.values():
+            for name in slot:
+                if name in self.vars:
+                    self.vars[name].op = op
+        self.program._version += 1
+        from .shape_inference import infer_op_shapes
+
+        infer_op_shapes(op, self)
+        return op
+
+    def remove_op(self, index: int):
+        del self.ops[index]
+        self.program._version += 1
 
     def all_parameters(self) -> List[Parameter]:
         return [v for v in self.vars.values() if isinstance(v, Parameter)]

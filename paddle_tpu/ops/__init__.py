@@ -11,6 +11,7 @@ from . import (  # noqa: F401
     math_ops,
     nn_ops,
     optimizer_ops,
+    quantize_ops,
     reduce_ops,
     rnn_ops,
     sequence_ops,

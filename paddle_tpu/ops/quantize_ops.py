@@ -1,0 +1,181 @@
+"""Fake-quantization ops for QAT (reference: operators/fake_quantize_op.cc —
+fake_quantize_abs_max, fake_quantize_range_abs_max,
+fake_channel_wise_quantize_abs_max, fake_dequantize_max_abs,
+fake_channel_wise_dequantize_max_abs; plus the later
+moving_average_abs_max variant).
+
+Quantized values are kept in float storage (int grid, float dtype) exactly
+like the reference's simulated-quantization path. Gradients use the
+straight-through estimator: the reference registers an identity grad functor
+(FakeQuantGradFunctor), reproduced here with jax.custom_vjp so AD through
+the traced program matches.
+
+State (running scales, window buffers) flows through the in-place output
+convention the executor already uses for BN running stats: the op writes
+OutScale/OutScales to the same persistable var names, and the jitted step
+returns them as updated state.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import OpContext, register_op
+
+
+@jax.custom_vjp
+def _ste(x, q):
+    """Forward: q(x); backward: identity into x (reference FakeQuantGradFunctor)."""
+    return q
+
+
+def _ste_fwd(x, q):
+    return q, None
+
+
+def _ste_bwd(_, g):
+    return g, None
+
+
+_ste.defvjp(_ste_fwd, _ste_bwd)
+
+
+def _qrange(bits: int) -> float:
+    return float((1 << (bits - 1)) - 1)
+
+
+def quantize_abs_max(x, bits: int):
+    """→ (quantized int-grid values in float, scale)."""
+    r = _qrange(bits)
+    scale = jnp.max(jnp.abs(x))
+    # the scale's own gradient is defined to be zero (reference
+    # FakeQuantGradFunctor is pure identity) — stop_gradient it everywhere
+    safe = jax.lax.stop_gradient(jnp.maximum(scale, 1e-8))
+    q = jnp.round(jnp.clip(x / safe, -1.0, 1.0) * r)
+    return _ste(x * (r / safe), q), scale
+
+
+@register_op("fake_quantize_abs_max")
+def fake_quantize_abs_max_op(ctx: OpContext):
+    x = ctx.input("X")
+    bits = int(ctx.attr("bit_length", 8))
+    out, scale = quantize_abs_max(x, bits)
+    ctx.set_output("Out", out)
+    ctx.set_output("OutScale", scale.reshape(1))
+
+
+@register_op("fake_channel_wise_quantize_abs_max")
+def fake_channel_wise_quantize_abs_max_op(ctx: OpContext):
+    """Per-output-channel (dim 0) scales — conv/mul weight quantization."""
+    x = ctx.input("X")
+    bits = int(ctx.attr("bit_length", 8))
+    r = _qrange(bits)
+    axes = tuple(range(1, x.ndim))
+    scale = jnp.max(jnp.abs(x), axis=axes)
+    safe = jax.lax.stop_gradient(
+        jnp.maximum(scale, 1e-8)).reshape((-1,) + (1,) * (x.ndim - 1))
+    q = jnp.round(jnp.clip(x / safe, -1.0, 1.0) * r)
+    ctx.set_output("Out", _ste(x * (r / safe), q))
+    ctx.set_output("OutScale", scale)
+
+
+@register_op("fake_quantize_range_abs_max")
+def fake_quantize_range_abs_max_op(ctx: OpContext):
+    """Windowed max scale (reference FakeQuantizeRangeAbsMaxOp): a
+    [window_size] buffer of per-step abs-maxes; OutScale = max(window).
+    Test mode uses the frozen InScale."""
+    x = ctx.input("X")
+    in_scale = ctx.input("InScale")          # [1] persistable
+    it = ctx.input("Iter")                   # [1] int64 persistable
+    window = ctx.input("OutScales")          # [window_size] persistable
+    bits = int(ctx.attr("bit_length", 8))
+    window_size = int(ctx.attr("window_size", 10000))
+    r = _qrange(bits)
+
+    if ctx.is_test:
+        scale = in_scale.reshape(())
+    else:
+        cur = jnp.max(jnp.abs(x))
+        pos = (it.reshape(()).astype(jnp.int32)) % window_size
+        window = window.at[pos].set(cur)
+        scale = jnp.max(window)
+        ctx.set_output("OutScales", window)
+        ctx.set_output("OutScale", scale.reshape(1))
+    safe = jax.lax.stop_gradient(jnp.maximum(scale, 1e-8))
+    q = jnp.round(jnp.clip(x / safe, -1.0, 1.0) * r)
+    ctx.set_output("Out", _ste(x * (r / safe), q))
+
+
+@register_op("fake_quantize_moving_average_abs_max")
+def fake_quantize_moving_average_abs_max_op(ctx: OpContext):
+    """EMA scale: state = accum/state counters (reference
+    FakeQuantizeMovingAverageAbsMaxOp)."""
+    x = ctx.input("X")
+    in_scale = ctx.input("InScale")
+    in_accum = ctx.input("InAccum")
+    in_state = ctx.input("InState")
+    bits = int(ctx.attr("bit_length", 8))
+    rho = float(ctx.attr("moving_rate", 0.9))
+    r = _qrange(bits)
+    if ctx.is_test:
+        scale = in_scale.reshape(())
+    else:
+        cur = jnp.max(jnp.abs(x))
+        accum = (in_accum.reshape(()) if in_accum is not None else 0.0) * rho + cur
+        state = (in_state.reshape(()) if in_state is not None else 0.0) * rho + 1.0
+        scale = accum / state
+        ctx.set_output("OutAccum", accum.reshape(1))
+        ctx.set_output("OutState", state.reshape(1))
+        ctx.set_output("OutScale", scale.reshape(1))
+    safe = jax.lax.stop_gradient(jnp.maximum(scale, 1e-8))
+    q = jnp.round(jnp.clip(x / safe, -1.0, 1.0) * r)
+    ctx.set_output("Out", _ste(x * (r / safe), q))
+
+
+@register_op("fake_dequantize_max_abs")
+def fake_dequantize_max_abs_op(ctx: OpContext):
+    """Out = X * Scale / max_range (reference FakeDequantizeMaxAbsOp)."""
+    x = ctx.input("X")
+    scale = ctx.input("Scale").reshape(())
+    max_range = float(ctx.attr("max_range"))
+    ctx.set_output("Out", x * (scale / max_range))
+
+
+@register_op("fake_channel_wise_dequantize_max_abs")
+def fake_channel_wise_dequantize_max_abs_op(ctx: OpContext):
+    x = ctx.input("X")
+    scales = ctx.inputs("Scales")
+    bits = ctx.attr("quant_bits", [8])
+    out = x
+    for s, b in zip(scales, bits):
+        if s.ndim >= 1 and s.shape[0] == x.shape[0] and s.size > 1:
+            shp = (-1,) + (1,) * (x.ndim - 1)
+            out = out * (s.reshape(shp) / _qrange(int(b)))
+        else:
+            out = out * (s.reshape(()) / _qrange(int(b)))
+    ctx.set_output("Out", out)
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max")
+def fake_qdq_moving_average_op(ctx: OpContext):
+    """Fused quant+dequant (activation QAT in later reference versions)."""
+    x = ctx.input("X")
+    in_scale = ctx.input("InScale")
+    bits = int(ctx.attr("bit_length", 8))
+    rho = float(ctx.attr("moving_rate", 0.9))
+    r = _qrange(bits)
+    in_accum, in_state = ctx.input("InAccum"), ctx.input("InState")
+    if ctx.is_test:
+        scale = in_scale.reshape(())
+    else:
+        cur = jnp.max(jnp.abs(x))
+        accum = (in_accum.reshape(()) if in_accum is not None else 0.0) * rho + cur
+        state = (in_state.reshape(()) if in_state is not None else 0.0) * rho + 1.0
+        scale = accum / state
+        ctx.set_output("OutAccum", accum.reshape(1))
+        ctx.set_output("OutState", state.reshape(1))
+        ctx.set_output("OutScale", scale.reshape(1))
+    safe = jax.lax.stop_gradient(jnp.maximum(scale, 1e-8))
+    q = jnp.round(jnp.clip(x / safe, -1.0, 1.0) * r) * (safe / r)
+    ctx.set_output("Out", _ste(x, q))

@@ -1,0 +1,134 @@
+"""Quantization tests: fake-quant op numerics vs numpy, STE gradients, and
+the QuantizeTranspiler QAT → freeze → int8 pipeline end to end (reference:
+contrib/tests/test_quantize_transpiler.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib import QuantizeTranspiler
+
+
+def _run(fetch, feed):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe.run(feed=feed, fetch_list=fetch if isinstance(fetch, list) else [fetch])
+
+
+def test_fake_quantize_abs_max_numerics(rng):
+    x_np = (rng.randn(4, 6) * 3).astype("float32")
+    x = fluid.layers.data("x", shape=[6])
+    helper = fluid.layers.nn.LayerHelper("q")
+    out = helper.create_variable_for_type_inference("float32")
+    scale = helper.create_variable_for_type_inference("float32")
+    helper.append_op("fake_quantize_abs_max", inputs={"X": x},
+                     outputs={"Out": out, "OutScale": scale},
+                     attrs={"bit_length": 8})
+    o, s = _run([out, scale], {"x": x_np})
+    exp_scale = np.max(np.abs(x_np))
+    np.testing.assert_allclose(s[0], exp_scale, rtol=1e-6)
+    np.testing.assert_allclose(o, np.round(x_np / exp_scale * 127), atol=1e-4)
+
+
+def test_fake_quant_dequant_roundtrip_error_bounded(rng):
+    x_np = (rng.randn(8, 8)).astype("float32")
+    x = fluid.layers.data("x", shape=[8])
+    helper = fluid.layers.nn.LayerHelper("q")
+    q = helper.create_variable_for_type_inference("float32")
+    scale = helper.create_variable_for_type_inference("float32")
+    dq = helper.create_variable_for_type_inference("float32")
+    helper.append_op("fake_quantize_abs_max", inputs={"X": x},
+                     outputs={"Out": q, "OutScale": scale}, attrs={"bit_length": 8})
+    helper.append_op("fake_dequantize_max_abs", inputs={"X": q, "Scale": scale},
+                     outputs={"Out": dq}, attrs={"max_range": 127.0})
+    o, = _run(dq, {"x": x_np})
+    # max error = scale/127/2
+    bound = np.max(np.abs(x_np)) / 127.0
+    assert np.max(np.abs(o - x_np)) <= bound
+
+
+def test_ste_gradient_identity(rng):
+    """Quant→dequant pair must pass gradients straight through (STE)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.quantize_ops import quantize_abs_max
+
+    x = jnp.asarray(rng.randn(5, 5).astype("float32"))
+
+    def f(v):
+        q, s = quantize_abs_max(v, 8)
+        return jnp.sum(q * (jax.lax.stop_gradient(s) / 127.0) * 2.0)
+
+    g = jax.grad(f)(x)
+    np.testing.assert_allclose(g, np.full((5, 5), 2.0), rtol=1e-5)
+
+
+@pytest.mark.parametrize("act_type", ["abs_max", "moving_average_abs_max", "range_abs_max"])
+def test_qat_training_converges(rng, act_type):
+    """QAT-transpiled MLP trains to decreasing loss; quant ops are present."""
+    dim, classes = 16, 4
+    centers = rng.randn(classes, dim).astype("float32") * 3
+    ys = rng.randint(0, classes, 128)
+    xs = (centers[ys] + rng.randn(128, dim) * 0.3).astype("float32")
+    ys = ys.reshape(-1, 1).astype("int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[dim])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=32, act="relu")
+        logits = fluid.layers.fc(h, size=classes)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(logits, y))
+        t = QuantizeTranspiler(activation_quantize_type=act_type)
+        t.training_transpile(main, startup)
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+
+    qops = [op.type for b in main.blocks for op in b.ops
+            if op.type.startswith("fake_quantize")]
+    assert len(qops) >= 4, f"quant ops not inserted: {qops}"
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = [float(exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])[0])
+              for _ in range(20)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_qat_freeze_and_int8(rng):
+    """freeze_program: weights land on the int grid, inference stays close
+    to the QAT model; convert_to_int8 stores int8 arrays."""
+    dim, classes = 8, 3
+    xs = rng.randn(32, dim).astype("float32")
+    ys = rng.randint(0, classes, (32, 1)).astype("int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[dim])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        logits = fluid.layers.fc(x, size=classes, param_attr=fluid.ParamAttr(name="w"))
+        sm = fluid.layers.softmax(logits)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(sm, y))
+        t = QuantizeTranspiler()
+        t.training_transpile(main, startup)
+        test_program = main.clone(for_test=True)
+        fluid.optimizer.SGD(0.05).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    for _ in range(5):
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    qat_out, = exe.run(test_program, feed={"x": xs, "y": ys}, fetch_list=[sm])
+
+    t.freeze_program(test_program)
+    frozen_w = fluid.global_scope().as_numpy("w")
+    assert np.all(np.abs(frozen_w - np.round(frozen_w)) < 1e-5), "weights not on int grid"
+    assert np.max(np.abs(frozen_w)) <= 127
+    frozen_out, = exe.run(test_program, feed={"x": xs, "y": ys}, fetch_list=[sm.name + ".dequantized"]) \
+        if False else exe.run(test_program, feed={"x": xs, "y": ys}, fetch_list=[sm])
+    np.testing.assert_allclose(frozen_out, qat_out, atol=5e-2)
+
+    converted = t.convert_to_int8(test_program)
+    assert "w" in converted
+    assert fluid.global_scope().as_numpy("w").dtype == np.int8
