@@ -1329,3 +1329,135 @@ def sample_logits(logits, label, num_samples, uniq=True,
 
 __all__ += ["warpctc", "ctc_greedy_decoder", "linear_chain_crf", "crf_decoding",
             "nce", "hsigmoid", "sample_logits"]
+
+
+# -- metrics / vision tail / host ops -----------------------------------------
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """Levenshtein distance (reference: layers/nn.py edit_distance).
+    input [B, Lh] int + input_length, label [B, Lr] + label_length."""
+    helper = LayerHelper("edit_distance")
+    out = helper.create_variable_for_type_inference("float32")
+    seq_num = helper.create_variable_for_type_inference("int64")
+    inputs = {"Hyps": input, "Refs": label}
+    if input_length is not None:
+        inputs["HypsLength"] = input_length
+    if label_length is not None:
+        inputs["RefsLength"] = label_length
+    helper.append_op("edit_distance", inputs=inputs,
+                     outputs={"Out": out, "SequenceNum": seq_num},
+                     attrs={"normalized": normalized})
+    return out, seq_num
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """Chunk detection P/R/F1 (reference: layers/nn.py chunk_eval)."""
+    helper = LayerHelper("chunk_eval")
+    precision = helper.create_variable_for_type_inference("float32")
+    recall = helper.create_variable_for_type_inference("float32")
+    f1 = helper.create_variable_for_type_inference("float32")
+    n_inf = helper.create_variable_for_type_inference("int64")
+    n_lab = helper.create_variable_for_type_inference("int64")
+    n_cor = helper.create_variable_for_type_inference("int64")
+    inputs = {"Inference": input, "Label": label}
+    if seq_length is not None:
+        inputs["Length"] = seq_length
+    helper.append_op(
+        "chunk_eval", inputs=inputs,
+        outputs={"Precision": precision, "Recall": recall, "F1-Score": f1,
+                 "NumInferChunks": n_inf, "NumLabelChunks": n_lab,
+                 "NumCorrectChunks": n_cor},
+        attrs={"num_chunk_types": num_chunk_types, "chunk_scheme": chunk_scheme,
+               "excluded_chunk_types": excluded_chunk_types or []})
+    return precision, recall, f1, n_inf, n_lab, n_cor
+
+
+def grid_sampler(x, grid, name=None):
+    """Bilinear grid sampling (reference: operators/grid_sampler_op.cc)."""
+    helper = LayerHelper("grid_sampler", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("grid_sampler", inputs={"X": x, "Grid": grid},
+                     outputs={"Output": out})
+    return out
+
+
+def spp(input, pyramid_height=1, pool_type="max", name=None):
+    """Spatial pyramid pooling (reference: operators/spp_op.cc)."""
+    helper = LayerHelper("spp", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("spp", inputs={"X": input}, outputs={"Out": out},
+                     attrs={"pyramid_height": pyramid_height,
+                            "pooling_type": pool_type})
+    return out
+
+
+def unpool(x, indices, ksize, strides=None, unpooled_size=None, name=None):
+    """Max unpooling via recorded indices (reference: operators/unpool_op.cc)."""
+    helper = LayerHelper("unpool", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("unpool", inputs={"X": x, "Indices": indices},
+                     outputs={"Out": out},
+                     attrs={"ksize": list(ksize),
+                            "strides": list(strides or ksize),
+                            "unpooled_size": list(unpooled_size) if unpooled_size else None})
+    return out
+
+
+def max_pool2d_with_index(x, ksize, strides=None, paddings=None, name=None):
+    helper = LayerHelper("max_pool2d_with_index", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    mask = helper.create_variable_for_type_inference("int32")
+    helper.append_op("max_pool2d_with_index", inputs={"X": x},
+                     outputs={"Out": out, "Mask": mask},
+                     attrs={"ksize": list(ksize), "strides": list(strides or ksize),
+                            "paddings": list(paddings or [0, 0])})
+    return out, mask
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, batch_id=None, name=None):
+    helper = LayerHelper("psroi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("psroi_pool",
+                     inputs={"X": input, "ROIs": rois, "BatchId": batch_id},
+                     outputs={"Out": out},
+                     attrs={"output_channels": output_channels,
+                            "spatial_scale": float(spatial_scale),
+                            "pooled_height": pooled_height,
+                            "pooled_width": pooled_width})
+    return out
+
+
+def Print(input, first_n=-1, message=None, summarize=-1, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Tensor tap-out (reference: layers/control_flow.py Print → print_op.cc)."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("print", inputs={"In": input}, outputs={"Out": out},
+                     attrs={"message": message or "", "first_n": first_n,
+                            "summarize": summarize})
+    return out
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python op (reference: layers/nn.py py_func → py_func_op.cc).
+    ``out`` must be pre-created variables with known shape/dtype."""
+    from ..ops.misc_ops import register_py_func
+
+    helper = LayerHelper("py_func")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    fwd_id = register_py_func(func)
+    bwd_id = register_py_func(backward_func) if backward_func else -1
+    helper.append_op("py_func", inputs={"X": list(xs)}, outputs={"Out": list(outs)},
+                     attrs={"forward_callable_id": fwd_id,
+                            "backward_callable_id": bwd_id})
+    return out
+
+
+__all__ += ["edit_distance", "chunk_eval", "grid_sampler", "spp", "unpool",
+            "max_pool2d_with_index", "psroi_pool", "Print", "py_func"]

@@ -9,6 +9,8 @@ from . import (  # noqa: F401
     detection_ops,
     loss_ops,
     math_ops,
+    metric_ops,
+    misc_ops,
     nn_ops,
     optimizer_ops,
     quantize_ops,
@@ -16,4 +18,5 @@ from . import (  # noqa: F401
     rnn_ops,
     sequence_ops,
     tensor_ops,
+    vision_ops,
 )

@@ -1,0 +1,204 @@
+"""Sequence/classification metric ops (reference: operators/chunk_eval_op.h,
+edit_distance_op.cc, precision_recall_op.cc).
+
+TPU-first: the reference walks sequences with host loops; here chunk
+detection is a pair of vectorized begin/end boundary predicates (two chunks
+are identical iff same begin ∧ same end ∧ same type — so correctness counts
+reduce to mask conjunctions), and edit distance is one ``lax.scan`` DP over
+the padded hypothesis axis, vmapped over the batch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import OpContext, register_op
+
+_SCHEMES = {
+    # scheme: (num_tag_types, begin, inside, end, single)
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _chunk_bounds(labels, lens, num_chunk_types, scheme):
+    """labels [B, T] → (is_begin, end_pos_for_begin, type) masks.
+
+    Implements the reference's ChunkBegin/ChunkEnd predicates
+    (chunk_eval_op.h:83,96) positionally over the padded batch.
+    """
+    ntag, t_begin, t_inside, t_end, t_single = _SCHEMES[scheme]
+    other = num_chunk_types
+    b, t = labels.shape
+    tag = labels % ntag
+    typ = labels // ntag
+    valid = jnp.arange(t)[None, :] < lens[:, None]
+    typ = jnp.where(valid, typ, other)  # padding behaves like Outside
+
+    prev_tag = jnp.pad(tag, ((0, 0), (1, 0)))[:, :t]
+    prev_typ = jnp.pad(typ, ((0, 0), (1, 0)), constant_values=other)[:, :t]
+    next_tag = jnp.pad(tag, ((0, 0), (0, 1)))[:, 1:]
+    next_typ = jnp.pad(typ, ((0, 0), (0, 1)), constant_values=other)[:, 1:]
+
+    def begin(ptag, ptyp, ctag, ctyp):
+        r = jnp.where(ptyp == other, ctyp != other,
+            jnp.where(ctyp == other, False,
+            jnp.where(ctyp != ptyp, True,
+            jnp.where(ctag == t_begin, True,
+            jnp.where(ctag == t_inside, (ptag == t_end) | (ptag == t_single),
+            jnp.where(ctag == t_end, (ptag == t_end) | (ptag == t_single),
+            jnp.where(ctag == t_single, True, False)))))))
+        return r & (ctyp != other)
+
+    def end(ctag, ctyp, ntag_, ntyp):
+        # chunk ends AT position i iff ChunkEnd(prev=i, cur=i+1)
+        return jnp.where(ctyp == other, False,
+               jnp.where(ntyp == other, True,
+               jnp.where(ntyp != ctyp, True,
+               jnp.where(ctag == t_begin, (ntag_ == t_begin) | (ntag_ == t_single),
+               jnp.where(ctag == t_inside, (ntag_ == t_begin) | (ntag_ == t_single),
+               jnp.where(ctag == t_end, True,
+               jnp.where(ctag == t_single, True, False)))))))
+
+    is_begin = begin(prev_tag, prev_typ, tag, typ)
+    is_end = end(tag, typ, next_tag, next_typ)
+
+    # end position of the chunk open at/after position i: reverse cummin of
+    # end indices
+    idx = jnp.arange(t)[None, :]
+    end_idx = jnp.where(is_end, idx, t + 1)
+    end_pos = jax.lax.associative_scan(jnp.minimum, end_idx[:, ::-1], axis=1)[:, ::-1]
+    return is_begin, end_pos, typ
+
+
+@register_op("chunk_eval")
+def chunk_eval_op(ctx: OpContext):
+    """Inference [B, T] + Label [B, T] (+ Length [B]) → Precision, Recall,
+    F1-Score, NumInferChunks, NumLabelChunks, NumCorrectChunks."""
+    inf = ctx.input("Inference").astype(jnp.int32)
+    lab = ctx.input("Label").astype(jnp.int32)
+    if inf.ndim == 3:
+        inf = inf[..., 0]
+    if lab.ndim == 3:
+        lab = lab[..., 0]
+    lens = ctx.input("Length")
+    nct = int(ctx.attr("num_chunk_types"))
+    scheme = ctx.attr("chunk_scheme", "IOB")
+    excluded = list(ctx.attr("excluded_chunk_types", []) or [])
+    b, t = inf.shape
+    if lens is None:
+        lens = jnp.full((b,), t, jnp.int32)
+    lens = lens.astype(jnp.int32)
+
+    ib_i, ep_i, ty_i = _chunk_bounds(inf, lens, nct, scheme)
+    ib_l, ep_l, ty_l = _chunk_bounds(lab, lens, nct, scheme)
+
+    def not_excluded(ty):
+        ok = jnp.ones_like(ty, bool)
+        for e in excluded:
+            ok &= ty != e
+        return ok
+
+    n_inf = jnp.sum((ib_i & not_excluded(ty_i)).astype(jnp.int64))
+    n_lab = jnp.sum((ib_l & not_excluded(ty_l)).astype(jnp.int64))
+    correct = (ib_i & ib_l & (ty_i == ty_l) & (ep_i == ep_l)
+               & not_excluded(ty_i))
+    n_cor = jnp.sum(correct.astype(jnp.int64))
+
+    p = jnp.where(n_inf > 0, n_cor / jnp.maximum(n_inf, 1), 0.0).astype(jnp.float32)
+    r = jnp.where(n_lab > 0, n_cor / jnp.maximum(n_lab, 1), 0.0).astype(jnp.float32)
+    f1 = jnp.where(p + r > 0, 2 * p * r / jnp.maximum(p + r, 1e-12), 0.0)
+    ctx.set_output("Precision", p.reshape(1))
+    ctx.set_output("Recall", r.reshape(1))
+    ctx.set_output("F1-Score", f1.reshape(1))
+    ctx.set_output("NumInferChunks", n_inf.reshape(1))
+    ctx.set_output("NumLabelChunks", n_lab.reshape(1))
+    ctx.set_output("NumCorrectChunks", n_cor.reshape(1))
+
+
+@register_op("edit_distance")
+def edit_distance_op(ctx: OpContext):
+    """Levenshtein distance (reference: edit_distance_op.cc). Hyps [B, Lh] +
+    HypsLength, Refs [B, Lr] + RefsLength → Out [B, 1], SequenceNum [1]."""
+    hyps = ctx.input("Hyps").astype(jnp.int32)
+    refs = ctx.input("Refs").astype(jnp.int32)
+    hl = ctx.input("HypsLength")
+    rl = ctx.input("RefsLength")
+    b, lh = hyps.shape
+    lr = refs.shape[1]
+    if hl is None:
+        hl = jnp.full((b,), lh, jnp.int32)
+    if rl is None:
+        rl = jnp.full((b,), lr, jnp.int32)
+    hl = hl.astype(jnp.int32)
+    rl = rl.astype(jnp.int32)
+
+    def one(h, r, hn, rn):
+        row0 = jnp.arange(lr + 1, dtype=jnp.float32)
+
+        def step(row, ht):
+            ins = row[:-1] + (ht != r).astype(jnp.float32)  # substitution cost
+            base = jnp.minimum(row[1:] + 1.0, ins)
+
+            def inner(carry, b_):
+                v = jnp.minimum(b_, carry + 1.0)  # new[j+1] = min(base[j], new[j]+1)
+                return v, v
+
+            _, rest = jax.lax.scan(inner, row[0] + 1.0, base)
+            new = jnp.concatenate([jnp.array([row[0] + 1.0]), rest])
+            return new, new
+
+        _, rows = jax.lax.scan(step, row0, h)
+        all_rows = jnp.concatenate([row0[None], rows], axis=0)  # [Lh+1, Lr+1]
+        return all_rows[hn, rn]
+
+    dist = jax.vmap(one)(hyps, refs, hl, rl)
+    if ctx.attr("normalized", False):
+        dist = dist / jnp.maximum(rl.astype(jnp.float32), 1.0)
+    ctx.set_output("Out", dist[:, None])
+    ctx.set_output("SequenceNum", jnp.asarray([b], jnp.int64))
+
+
+@register_op("precision_recall")
+def precision_recall_op(ctx: OpContext):
+    """Multi-class precision/recall/F1 (reference: precision_recall_op.cc).
+
+    Indices [B, 1] predicted class, Labels [B, 1], optional Weights [B, 1],
+    optional StatesInfo [C, 4] accumulator (TP, FP, TN, FN per class) →
+    BatchMetrics [6] (macro-P/R/F1, micro-P/R/F1), AccumMetrics [6],
+    AccumStatesInfo [C, 4]."""
+    idx = ctx.input("Indices").reshape(-1).astype(jnp.int32)
+    lab = ctx.input("Labels").reshape(-1).astype(jnp.int32)
+    w = ctx.input("Weights")
+    states = ctx.input("StatesInfo")
+    c = int(ctx.attr("class_number"))
+    b = idx.shape[0]
+    w = jnp.ones((b,), jnp.float32) if w is None else w.reshape(-1).astype(jnp.float32)
+
+    onehot_p = jax.nn.one_hot(idx, c, dtype=jnp.float32) * w[:, None]
+    onehot_l = jax.nn.one_hot(lab, c, dtype=jnp.float32) * w[:, None]
+    tp = jnp.sum(onehot_p * (idx == lab)[:, None].astype(jnp.float32), axis=0)
+    fp = jnp.sum(onehot_p, axis=0) - tp
+    fn = jnp.sum(onehot_l, axis=0) - tp
+    tn = jnp.sum(w) - tp - fp - fn
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)  # [C, 4]
+
+    def metrics(st):
+        tp_, fp_, _tn, fn_ = st[:, 0], st[:, 1], st[:, 2], st[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1e-12), 0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1e-12), 0.0)
+        f1 = jnp.where(prec + rec > 0, 2 * prec * rec / jnp.maximum(prec + rec, 1e-12), 0.0)
+        macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+        stp, sfp, sfn = jnp.sum(tp_), jnp.sum(fp_), jnp.sum(fn_)
+        mp = jnp.where(stp + sfp > 0, stp / jnp.maximum(stp + sfp, 1e-12), 0.0)
+        mr = jnp.where(stp + sfn > 0, stp / jnp.maximum(stp + sfn, 1e-12), 0.0)
+        mf = jnp.where(mp + mr > 0, 2 * mp * mr / jnp.maximum(mp + mr, 1e-12), 0.0)
+        return jnp.concatenate([macro, jnp.stack([mp, mr, mf])])
+
+    accum = batch_states if states is None else states.astype(jnp.float32) + batch_states
+    ctx.set_output("BatchMetrics", metrics(batch_states))
+    ctx.set_output("AccumMetrics", metrics(accum))
+    ctx.set_output("AccumStatesInfo", accum)

@@ -1,0 +1,97 @@
+"""Host-interaction ops: print, py_func (reference: operators/print_op.cc,
+py_func_op.cc).
+
+Under XLA these are host callbacks: ``print`` uses jax.debug.print /
+debug.callback (works inside jit, tapped out at run time), ``py_func``
+uses pure_callback with an optional user backward function wired through
+custom_vjp — the reference's RegisterPyFunc machinery without the global
+function table.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import OpContext, register_op
+
+# py_func registry: attr stores an integer handle (program-serializable),
+# matching the reference's global PyFuncRegistry ids (py_func_op.cc).
+_PY_FUNCS: Dict[int, Callable] = {}
+
+
+def register_py_func(fn: Callable) -> int:
+    handle = len(_PY_FUNCS)
+    _PY_FUNCS[handle] = fn
+    return handle
+
+
+@register_op("print")
+def print_op(ctx: OpContext):
+    """reference: print_op.cc — tensor tap-out; pass-through output."""
+    x = ctx.input("In" if ctx.has_input("In") else "X")
+    message = ctx.attr("message", "") or ""
+    first_n = ctx.attr("first_n", -1)  # accepted; XLA prints every call
+    summarize = int(ctx.attr("summarize", -1))
+    if summarize and summarize > 0:
+        flat = x.reshape(-1)[:summarize]
+        jax.debug.print(message + " {}", flat)
+    else:
+        jax.debug.print(message + " {}", x)
+    ctx.set_output("Out", x)
+
+
+@register_op("py_func")
+def py_func_op(ctx: OpContext):
+    """reference: py_func_op.cc. Runs a registered host function over the
+    inputs; output shapes/dtypes come from the declared output vars."""
+    xs = ctx.inputs("X")
+    handle = int(ctx.attr("forward_callable_id"))
+    bwd_handle = ctx.attr("backward_callable_id", -1)
+    fwd = _PY_FUNCS[handle]
+    out_vars = [ctx.op.block.var(n) for n in ctx.op.outputs.get("Out", [])]
+    result_shapes = [
+        jax.ShapeDtypeStruct(tuple(v.shape), np.dtype(v.dtype)) for v in out_vars
+    ]
+
+    def host_fwd(*arrays):
+        out = fwd(*[np.asarray(a) for a in arrays])
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return tuple(np.asarray(o, dtype=s.dtype).reshape(s.shape)
+                     for o, s in zip(outs, result_shapes))
+
+    def call_fwd(*args):
+        return jax.pure_callback(host_fwd, tuple(result_shapes), *args)
+
+    if bwd_handle is not None and int(bwd_handle) >= 0:
+        bwd = _PY_FUNCS[int(bwd_handle)]
+
+        @jax.custom_vjp
+        def f(*args):
+            return call_fwd(*args)
+
+        def f_fwd(*args):
+            return call_fwd(*args), args
+
+        def f_bwd(res, gs):
+            shapes = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype) for a in res)
+
+            def host_bwd(*all_args):
+                n = len(res)
+                xs_np = [np.asarray(a) for a in all_args[:n]]
+                gs_np = [np.asarray(a) for a in all_args[n:]]
+                grads = bwd(*xs_np, *gs_np)
+                grads = grads if isinstance(grads, (list, tuple)) else [grads]
+                return tuple(np.asarray(g, dtype=s.dtype).reshape(s.shape)
+                             for g, s in zip(grads, shapes))
+
+            return jax.pure_callback(host_bwd, shapes, *res, *gs)
+
+        f.defvjp(f_fwd, f_bwd)
+        outs = f(*xs)
+    else:
+        outs = call_fwd(*xs)
+    ctx.set_outputs("Out", outs)

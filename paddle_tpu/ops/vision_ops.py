@@ -1,0 +1,182 @@
+"""Vision-tail ops (reference: operators/spp_op.cc, unpool_op.cc,
+pool_with_index_op.cc (max_pool2d_with_index), grid_sampler_op.cc,
+psroi_pool_op.cc).
+
+All static-shape, gather/scatter-vectorized; adaptive bin boundaries use
+the floor(i·H/k)/ceil((i+1)·H/k) rule like the reference's adaptive pools.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import OpContext, register_op
+
+
+def _adaptive_bins(total: int, k: int):
+    starts = [int(np.floor(i * total / k)) for i in range(k)]
+    ends = [int(np.ceil((i + 1) * total / k)) for i in range(k)]
+    return starts, ends
+
+
+def _adaptive_pool2d(x, k: int, ptype: str):
+    """[N, C, H, W] → [N, C, k, k] with reference adaptive bin boundaries."""
+    n, c, h, w = x.shape
+    hs, he = _adaptive_bins(h, k)
+    ws, we = _adaptive_bins(w, k)
+    red = jnp.max if ptype == "max" else jnp.mean
+    rows = []
+    for i in range(k):
+        cols = [red(x[:, :, hs[i]:he[i], ws[j]:we[j]], axis=(2, 3)) for j in range(k)]
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)
+
+
+@register_op("spp")
+def spp_op(ctx: OpContext):
+    """Spatial pyramid pooling (reference: spp_op.cc): levels 2^0..2^(L-1)
+    bins, flattened + concatenated → [N, C·Σ4^l]."""
+    x = ctx.input("X")
+    levels = int(ctx.attr("pyramid_height", 1))
+    ptype = ctx.attr("pooling_type", "max")
+    n = x.shape[0]
+    outs = []
+    for l in range(levels):
+        k = 2 ** l
+        outs.append(_adaptive_pool2d(x, k, ptype).reshape(n, -1))
+    ctx.set_output("Out", jnp.concatenate(outs, axis=1))
+
+
+@register_op("max_pool2d_with_index")
+def max_pool2d_with_index_op(ctx: OpContext):
+    """reference: pool_with_index_op.cc — Out + Mask of flat H*W argmax
+    indices (what unpool consumes)."""
+    x = ctx.input("X")
+    ksize = list(ctx.attr("ksize", [2, 2]))
+    strides = list(ctx.attr("strides", ksize))
+    paddings = list(ctx.attr("paddings", [0, 0]))
+    n, c, h, w = x.shape
+    kh, kw = ksize
+    sh, sw = strides
+    ph, pw = paddings
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    # window gather: build [oh, ow, kh, kw] index grids into padded input
+    iy = (jnp.arange(oh) * sh)[:, None, None, None] + jnp.arange(kh)[None, None, :, None] - ph
+    ix = (jnp.arange(ow) * sw)[None, :, None, None] + jnp.arange(kw)[None, None, None, :] - pw
+    iy = jnp.broadcast_to(iy, (oh, ow, kh, kw))
+    ix = jnp.broadcast_to(ix, (oh, ow, kh, kw))
+    inb = (iy >= 0) & (iy < h) & (ix >= 0) & (ix < w)
+    iyc = jnp.clip(iy, 0, h - 1)
+    ixc = jnp.clip(ix, 0, w - 1)
+    vals = x[:, :, iyc, ixc]                                   # [N, C, oh, ow, kh, kw]
+    vals = jnp.where(inb[None, None], vals, -jnp.inf)
+    vflat = vals.reshape(n, c, oh, ow, kh * kw)
+    arg = jnp.argmax(vflat, axis=-1)
+    out = jnp.max(vflat, axis=-1)
+    ky, kx = arg // kw, arg % kw
+    gy = (jnp.arange(oh) * sh - ph)[None, None, :, None] + ky
+    gx = (jnp.arange(ow) * sw - pw)[None, None, None, :] + kx
+    mask = gy * w + gx
+    ctx.set_output("Out", out)
+    ctx.set_output("Mask", mask.astype(jnp.int32))
+
+
+@register_op("unpool")
+def unpool_op(ctx: OpContext):
+    """Max unpooling (reference: unpool_op.cc): scatter X back to the flat
+    positions recorded in Indices; unpooled size from attrs."""
+    x = ctx.input("X")                       # [N, C, oh, ow]
+    indices = ctx.input("Indices").astype(jnp.int32)
+    ksize = list(ctx.attr("ksize", [2, 2]))
+    strides = list(ctx.attr("strides", ksize))
+    unpooled = ctx.attr("unpooled_size", None)
+    n, c, oh, ow = x.shape
+    if unpooled:
+        uh, uw = int(unpooled[0]), int(unpooled[1])
+    else:
+        uh = (oh - 1) * strides[0] + ksize[0]
+        uw = (ow - 1) * strides[1] + ksize[1]
+
+    flat_idx = indices.reshape(n, c, -1)
+    vals = x.reshape(n, c, -1)
+    out = jnp.zeros((n, c, uh * uw), x.dtype)
+    out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(out, flat_idx, vals)
+    ctx.set_output("Out", out.reshape(n, c, uh, uw))
+
+
+@register_op("grid_sampler")
+def grid_sampler_op(ctx: OpContext):
+    """Bilinear sampling at normalized [-1, 1] grid coords (reference:
+    grid_sampler_op.cc). X [N, C, H, W], Grid [N, Ho, Wo, 2] → [N, C, Ho, Wo]."""
+    x = ctx.input("X")
+    grid = ctx.input("Grid")
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0   # [N, Ho, Wo]
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    lx = gx - x0
+    ly = gy - y0
+
+    def gather(yy, xx):
+        inb = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        v = jax.vmap(lambda img, yi, xi: img[:, yi, xi])(x, yc, xc)  # [N, C, Ho, Wo]
+        return jnp.where(inb[:, None], v, 0.0)
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    lx = lx[:, None]
+    ly = ly[:, None]
+    out = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+           + v10 * ly * (1 - lx) + v11 * ly * lx)
+    ctx.set_output("Output", out)
+
+
+@register_op("psroi_pool")
+def psroi_pool_op(ctx: OpContext):
+    """Position-sensitive RoI pooling (reference: psroi_pool_op.cc):
+    input channels C = output_channels · ph · pw; bin (i, j) averages its own
+    channel group. ROIs [R, 4] + BatchId [R]."""
+    x = ctx.input("X")
+    rois = ctx.input("ROIs")
+    batch_id = ctx.input("BatchId")
+    if batch_id is None:
+        batch_id = jnp.zeros((rois.shape[0],), jnp.int32)
+    oc = int(ctx.attr("output_channels"))
+    ph = int(ctx.attr("pooled_height", 1))
+    pw = int(ctx.attr("pooled_width", 1))
+    scale = float(ctx.attr("spatial_scale", 1.0))
+    n, c, h, w = x.shape
+    ygrid = jnp.arange(h, dtype=jnp.float32)
+    xgrid = jnp.arange(w, dtype=jnp.float32)
+
+    def one(roi, bid):
+        feat = x[bid].reshape(oc, ph, pw, h, w)
+        x1 = jnp.round(roi[0]) * scale
+        y1 = jnp.round(roi[1]) * scale
+        x2 = jnp.round(roi[2] + 1.0) * scale
+        y2 = jnp.round(roi[3] + 1.0) * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bw, bh = rw / pw, rh / ph
+
+        def bin_val(i, j):
+            ys, ye = y1 + i * bh, y1 + (i + 1) * bh
+            xs, xe = x1 + j * bw, x1 + (j + 1) * bw
+            m = ((ygrid[:, None] >= jnp.floor(ys)) & (ygrid[:, None] < jnp.ceil(ye))
+                 & (xgrid[None, :] >= jnp.floor(xs)) & (xgrid[None, :] < jnp.ceil(xe)))
+            cnt = jnp.maximum(jnp.sum(m.astype(jnp.float32)), 1.0)
+            return jnp.sum(jnp.where(m[None], feat[:, i, j], 0.0), axis=(1, 2)) / cnt
+
+        rows = [jnp.stack([bin_val(i, j) for j in range(pw)], axis=-1) for i in range(ph)]
+        return jnp.stack(rows, axis=-2)  # [oc, ph, pw]
+
+    ctx.set_output("Out", jax.vmap(one)(rois, batch_id.astype(jnp.int32)))

@@ -1,0 +1,42 @@
+"""API surface freeze (reference: paddle/fluid/API.spec enforced by
+tools/diff_api.py in CI): the committed API.spec must match the live
+signatures — an intentional change regenerates it via
+``python tools/print_signatures.py > API.spec`` in the same commit."""
+
+import difflib
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_api_spec_is_current():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import print_signatures
+    finally:
+        sys.path.pop(0)
+    live = print_signatures.collect()
+    with open(os.path.join(REPO, "API.spec")) as f:
+        committed = [l.rstrip("\n") for l in f if l.strip()]
+    if live != committed:
+        diff = "\n".join(difflib.unified_diff(
+            committed, live, "API.spec (committed)", "API.spec (live)", lineterm=""))
+        raise AssertionError(
+            "Public API surface changed without updating API.spec.\n"
+            "If intentional: python tools/print_signatures.py > API.spec\n" + diff)
+
+
+def test_core_api_presence():
+    """A few load-bearing names that must never silently vanish."""
+    with open(os.path.join(REPO, "API.spec")) as f:
+        spec = f.read()
+    for needle in [
+        "paddle_tpu.Executor",
+        "paddle_tpu.layers.fc ",
+        "paddle_tpu.layers.ssd_loss ",
+        "paddle_tpu.optimizer.AdamOptimizer",
+        "paddle_tpu.imperative.guard ",
+        "paddle_tpu.io.save_inference_model ",
+    ]:
+        assert needle in spec, "missing from API.spec: %r" % needle

@@ -1,0 +1,206 @@
+"""Tests for metric ops (edit_distance vs python Levenshtein, chunk_eval vs
+a hand-built IOB case, precision_recall vs sklearn-style numpy math), the
+vision tail (spp/unpool/grid_sampler/psroi_pool), and host ops
+(print/py_func)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _run(fetch, feed):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe.run(feed=feed, fetch_list=fetch if isinstance(fetch, list) else [fetch])
+
+
+# -- edit_distance ------------------------------------------------------------
+
+
+def _lev(a, b):
+    d = np.arange(len(b) + 1, dtype=float)
+    for i, ca in enumerate(a):
+        prev = d.copy()
+        d[0] = i + 1
+        for j, cb in enumerate(b):
+            d[j + 1] = min(prev[j + 1] + 1, d[j] + 1, prev[j] + (ca != cb))
+    return d[len(b)]
+
+
+def test_edit_distance_matches_python(rng):
+    b, lh, lr = 4, 7, 6
+    hyps = rng.randint(1, 5, (b, lh)).astype("int64")
+    refs = rng.randint(1, 5, (b, lr)).astype("int64")
+    hl = np.array([7, 5, 3, 0], "int64")
+    rl = np.array([6, 6, 2, 4], "int64")
+    h = fluid.layers.data("h", shape=[lh], dtype="int64")
+    r = fluid.layers.data("r", shape=[lr], dtype="int64")
+    hlv = fluid.layers.data("hl", shape=[], dtype="int64")
+    rlv = fluid.layers.data("rl", shape=[], dtype="int64")
+    out, seq_num = fluid.layers.edit_distance(
+        h, r, normalized=False, input_length=hlv, label_length=rlv)
+    got, n = _run([out, seq_num], {"h": hyps, "r": refs, "hl": hl, "rl": rl})
+    exp = [_lev(hyps[i, :hl[i]].tolist(), refs[i, :rl[i]].tolist()) for i in range(b)]
+    np.testing.assert_allclose(got[:, 0], exp)
+    assert int(n[0]) == b
+
+
+# -- chunk_eval ---------------------------------------------------------------
+
+
+def test_chunk_eval_iob(rng):
+    # IOB, 2 chunk types. tags: B=0 I=1 → label = type*2 + tag; O = 2*2=4
+    # label:  [B0 I0 O  B1 I1 I1 O  B0]  → chunks: (0,1,t0), (3,5,t1), (7,7,t0)
+    # infer:  [B0 I0 O  B1 O  I1 O  B0]  → chunks: (0,1,t0), (3,3,t1), (5,5,t1), (7,7,t0)
+    lab = np.array([[0, 1, 4, 2, 3, 3, 4, 0]], "int64")
+    inf = np.array([[0, 1, 4, 2, 4, 3, 4, 0]], "int64")
+    iv = fluid.layers.data("i", shape=[8], dtype="int64")
+    lv = fluid.layers.data("l", shape=[8], dtype="int64")
+    p, r, f1, ni, nl, nc = fluid.layers.chunk_eval(
+        iv, lv, chunk_scheme="IOB", num_chunk_types=2)
+    pv, rv, fv, niv, nlv, ncv = _run([p, r, f1, ni, nl, nc], {"i": inf, "l": lab})
+    assert int(niv[0]) == 4 and int(nlv[0]) == 3 and int(ncv[0]) == 2
+    np.testing.assert_allclose(pv[0], 2 / 4, rtol=1e-6)
+    np.testing.assert_allclose(rv[0], 2 / 3, rtol=1e-6)
+    np.testing.assert_allclose(fv[0], 2 * (0.5 * 2 / 3) / (0.5 + 2 / 3), rtol=1e-6)
+
+
+def test_chunk_eval_iobes_with_length(rng):
+    # IOBES: B=0 I=1 E=2 S=3; 1 type → O = 4
+    lab = np.array([[0, 1, 2, 4, 3, 0, 2, 0]], "int64")  # BIE O S BE (+pad)
+    inf = lab.copy()
+    ln = np.array([7], "int64")
+    iv = fluid.layers.data("i", shape=[8], dtype="int64")
+    lv = fluid.layers.data("l", shape=[8], dtype="int64")
+    lnv = fluid.layers.data("ln", shape=[], dtype="int64")
+    p, r, f1, ni, nl, nc = fluid.layers.chunk_eval(
+        iv, lv, chunk_scheme="IOBES", num_chunk_types=1, seq_length=lnv)
+    pv, rv, fv, niv, nlv, ncv = _run([p, r, f1, ni, nl, nc],
+                                     {"i": inf, "l": lab, "ln": ln})
+    # chunks in first 7: BIE(0-2), S(4), BE(5-6) = 3; perfect match
+    assert int(niv[0]) == 3 and int(nlv[0]) == 3 and int(ncv[0]) == 3
+    assert pv[0] == 1.0 and rv[0] == 1.0 and fv[0] == 1.0
+
+
+# -- precision_recall ---------------------------------------------------------
+
+
+def test_precision_recall_op(rng):
+    c, b = 3, 12
+    idx = rng.randint(0, c, (b, 1)).astype("int64")
+    lab = rng.randint(0, c, (b, 1)).astype("int64")
+    iv = fluid.layers.data("i", shape=[1], dtype="int64")
+    lv = fluid.layers.data("l", shape=[1], dtype="int64")
+    helper = fluid.layers.nn.LayerHelper("pr")
+    bm = helper.create_variable_for_type_inference("float32")
+    am = helper.create_variable_for_type_inference("float32")
+    st = helper.create_variable_for_type_inference("float32")
+    helper.append_op("precision_recall", inputs={"Indices": iv, "Labels": lv},
+                     outputs={"BatchMetrics": bm, "AccumMetrics": am,
+                              "AccumStatesInfo": st},
+                     attrs={"class_number": c})
+    bmv, stv = _run([bm, st], {"i": idx, "l": lab})[0:2]
+
+    # numpy reference
+    tp = np.array([np.sum((idx[:, 0] == k) & (lab[:, 0] == k)) for k in range(c)], float)
+    fp = np.array([np.sum((idx[:, 0] == k) & (lab[:, 0] != k)) for k in range(c)], float)
+    fn = np.array([np.sum((idx[:, 0] != k) & (lab[:, 0] == k)) for k in range(c)], float)
+    prec = np.where(tp + fp > 0, tp / np.maximum(tp + fp, 1e-12), 0)
+    rec = np.where(tp + fn > 0, tp / np.maximum(tp + fn, 1e-12), 0)
+    np.testing.assert_allclose(bmv[0], prec.mean(), rtol=1e-5)
+    np.testing.assert_allclose(bmv[1], rec.mean(), rtol=1e-5)
+    micro_p = tp.sum() / max(tp.sum() + fp.sum(), 1e-12)
+    np.testing.assert_allclose(bmv[3], micro_p, rtol=1e-5)
+    np.testing.assert_allclose(stv[:, 0], tp)
+
+
+# -- vision tail --------------------------------------------------------------
+
+
+def test_spp_shapes_and_values(rng):
+    x_np = rng.randn(2, 3, 8, 8).astype("float32")
+    x = fluid.layers.data("x", shape=[3, 8, 8])
+    out = fluid.layers.spp(x, pyramid_height=2, pool_type="max")
+    o, = _run(out, {"x": x_np})
+    assert o.shape == (2, 3 * (1 + 4))
+    np.testing.assert_allclose(o[:, :3], x_np.max(axis=(2, 3)), rtol=1e-6)
+    np.testing.assert_allclose(o[0, 3], x_np[0, 0, :4, :4].max(), rtol=1e-6)
+
+
+def test_max_pool_with_index_and_unpool_roundtrip(rng):
+    x_np = rng.randn(1, 2, 4, 4).astype("float32")
+    x = fluid.layers.data("x", shape=[2, 4, 4])
+    out, mask = fluid.layers.max_pool2d_with_index(x, ksize=[2, 2])
+    restored = fluid.layers.unpool(out, mask, ksize=[2, 2])
+    o, m, u = _run([out, mask, restored], {"x": x_np})
+    np.testing.assert_allclose(o[0, 0], x_np[0, 0].reshape(2, 2, 2, 2).max(axis=(1, 3)))
+    # unpool scatters each max back to its original position
+    assert u.shape == x_np.shape
+    for ci in range(2):
+        for i in range(2):
+            for j in range(2):
+                flat = m[0, ci, i, j]
+                assert u[0, ci].reshape(-1)[flat] == o[0, ci, i, j]
+    assert np.count_nonzero(u) <= 8
+
+
+def test_grid_sampler_identity(rng):
+    n, c, h, w = 1, 2, 5, 5
+    x_np = rng.randn(n, c, h, w).astype("float32")
+    ys, xs = np.meshgrid(np.linspace(-1, 1, h), np.linspace(-1, 1, w), indexing="ij")
+    grid_np = np.stack([xs, ys], -1)[None].astype("float32")
+    x = fluid.layers.data("x", shape=[c, h, w])
+    g = fluid.layers.data("g", shape=[h, w, 2])
+    out = fluid.layers.grid_sampler(x, g)
+    o, = _run(out, {"x": x_np, "g": grid_np})
+    np.testing.assert_allclose(o, x_np, rtol=1e-5, atol=1e-5)
+
+
+def test_psroi_pool_shapes(rng):
+    oc, ph, pw = 3, 2, 2
+    x_np = rng.randn(1, oc * ph * pw, 8, 8).astype("float32")
+    rois_np = np.array([[0.0, 0.0, 7.0, 7.0]], "float32")
+    x = fluid.layers.data("x", shape=[oc * ph * pw, 8, 8])
+    r = fluid.layers.data("r", shape=[4])
+    out = fluid.layers.psroi_pool(x, r, oc, 1.0, ph, pw)
+    o, = _run(out, {"x": x_np, "r": rois_np})
+    assert o.shape == (1, oc, ph, pw)
+    # bin (0,0) of output channel 0 averages channel 0 over the top-left
+    np.testing.assert_allclose(o[0, 0, 0, 0], x_np[0, 0, :4, :4].mean(), rtol=1e-5)
+
+
+# -- host ops -----------------------------------------------------------------
+
+
+def test_print_op_passthrough(rng, capfd):
+    x_np = rng.randn(2, 3).astype("float32")
+    x = fluid.layers.data("x", shape=[3])
+    out = fluid.layers.Print(x, message="dbg:", summarize=3)
+    y = fluid.layers.scale(out, scale=2.0)
+    o, = _run(y, {"x": x_np})
+    np.testing.assert_allclose(o, x_np * 2, rtol=1e-6)
+
+
+def test_py_func_forward_and_backward(rng):
+    x_np = rng.randn(4, 3).astype("float32")
+
+    def forward(a):
+        return np.tanh(a)
+
+    def backward(a, g):
+        return g * (1 - np.tanh(a) ** 2)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3], stop_gradient=False)
+        helper = fluid.layers.nn.LayerHelper("pf")
+        out = helper.create_variable_for_type_inference("float32")
+        out.shape = (4, 3)
+        fluid.layers.py_func(forward, x, out, backward_func=backward)
+        loss = fluid.layers.mean(out)
+        grads = fluid.backward.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    o, = exe.run(main, feed={"x": x_np}, fetch_list=[out])
+    np.testing.assert_allclose(o, np.tanh(x_np), rtol=1e-5)
