@@ -194,3 +194,101 @@ def load_inference_model(dirname, executor, model_filename=None, params_filename
     program = serialization.desc_to_program(desc)
     load_vars(executor, dirname, vars=None, filename=params_filename or _COMBINED_DEFAULT)
     return program, desc.get("feed_names", []), desc.get("fetch_names", [])
+
+
+# -- rotating checkpoints + preemption resume ---------------------------------
+# (reference: contrib/trainer.py CheckpointConfig:100 + the Trainer's
+# _save_checkpoint/_load_checkpoint; SURVEY §5.3/5.4 elastic resume)
+
+_CKPT_PREFIX = "checkpoint_"
+_SUCCESS_MARK = "_SUCCESS"
+
+
+class CheckpointConfig:
+    """reference: contrib/trainer.py:100."""
+
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10):
+        self.checkpoint_dir = checkpoint_dir or os.getcwd()
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = epoch_interval
+        self.step_interval = step_interval
+
+
+def _checkpoint_serials(checkpoint_dir):
+    if not os.path.isdir(checkpoint_dir):
+        return []
+    serials = []
+    for name in os.listdir(checkpoint_dir):
+        if name.startswith(_CKPT_PREFIX):
+            try:
+                serials.append(int(name[len(_CKPT_PREFIX):]))
+            except ValueError:
+                continue
+    return sorted(serials)
+
+
+def save_checkpoint(executor, checkpoint_dir, main_program=None,
+                    trainer_id=0, trainer_args=None, max_num_checkpoints=3):
+    """Write a new serial-numbered checkpoint of all persistables, atomically
+    (tmp dir + _SUCCESS marker), then rotate old ones. ``trainer_args``
+    (e.g. {'step': 123, 'epoch': 4}) are stored for resume bookkeeping."""
+    serials = _checkpoint_serials(checkpoint_dir)
+    serial = (serials[-1] + 1) if serials else 0
+    final = os.path.join(checkpoint_dir, _CKPT_PREFIX + str(serial))
+    tmp = final + ".tmp"
+    if os.path.isdir(tmp):
+        import shutil
+
+        shutil.rmtree(tmp)
+    save_persistables(executor, tmp, main_program)
+    with open(os.path.join(tmp, "trainer_args.json"), "w") as f:
+        json.dump({"trainer_id": trainer_id, **(trainer_args or {})}, f)
+    with open(os.path.join(tmp, _SUCCESS_MARK), "w") as f:
+        f.write("ok")
+    os.replace(tmp, final)
+    # rotate
+    serials.append(serial)
+    import shutil
+
+    for old in serials[:-max_num_checkpoints] if max_num_checkpoints > 0 else []:
+        shutil.rmtree(os.path.join(checkpoint_dir, _CKPT_PREFIX + str(old)),
+                      ignore_errors=True)
+    return serial
+
+
+def load_checkpoint(executor, checkpoint_dir, main_program=None, serial=None):
+    """Restore the latest complete checkpoint (or ``serial``); returns the
+    stored trainer_args dict, or None if no valid checkpoint exists — the
+    auto-resume contract: call at startup, train from scratch on None."""
+    serials = _checkpoint_serials(checkpoint_dir)
+    candidates = [serial] if serial is not None else list(reversed(serials))
+    for s in candidates:
+        d = os.path.join(checkpoint_dir, _CKPT_PREFIX + str(s))
+        if not os.path.isfile(os.path.join(d, _SUCCESS_MARK)):
+            continue  # partial write (preempted mid-save) — skip
+        load_persistables(executor, d, main_program)
+        try:
+            with open(os.path.join(d, "trainer_args.json")) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return {}
+    return None
+
+
+def clean_checkpoint(checkpoint_dir, delete_dir=False):
+    """reference: io.py clean_checkpoint."""
+    import shutil
+
+    for s in _checkpoint_serials(checkpoint_dir):
+        shutil.rmtree(os.path.join(checkpoint_dir, _CKPT_PREFIX + str(s)),
+                      ignore_errors=True)
+    if delete_dir and os.path.isdir(checkpoint_dir):
+        try:
+            os.rmdir(checkpoint_dir)
+        except OSError:
+            pass
+
+
+__all__ += ["CheckpointConfig", "save_checkpoint", "load_checkpoint",
+            "clean_checkpoint"]

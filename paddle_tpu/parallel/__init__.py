@@ -8,3 +8,4 @@ from .api import (  # noqa: F401
 from .distributed import init_distributed  # noqa: F401
 from .mesh import create_mesh, get_mesh, mesh_guard  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
+from .pipeline import gpipe, pipeline_step, stack_stage_params  # noqa: F401

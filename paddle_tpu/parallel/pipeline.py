@@ -1,0 +1,107 @@
+"""Pipeline parallelism over a mesh axis (SURVEY §2 component: the
+reference's pipeline trainer — paddle/fluid/framework/device_worker
+section-program pipeline; reimagined TPU-first).
+
+Design (the collective-pipelining recipe from the public scaling
+literature): stages are laid out along a ``pipe`` mesh axis; a GPipe
+schedule runs M microbatches through S stages in M+S-1 ticks inside a
+``lax.fori_loop``, rotating activations between neighbouring stages with
+``lax.ppermute`` over ICI. The whole schedule — including the bubble — is
+one compiled XLA computation, and the *backward* pipeline schedule falls
+out of JAX AD transposing the loop (ppermute transposes to the reverse
+rotation), so there is no hand-written 1F1B scheduler.
+
+Stage parameters live stacked on a leading [S, ...] axis sharded over
+``pipe`` — each device holds only its own stage's weights (the memory win
+that motivates pipeline parallelism).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["gpipe", "pipeline_step", "stack_stage_params"]
+
+
+def stack_stage_params(per_stage_params):
+    """[pytree per stage] → single pytree with leading stage axis [S, ...]."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def gpipe(stage_fn: Callable, mesh: Mesh, axis: str = "pipe"):
+    """Build a pipelined forward: ``fn(stacked_params, microbatches)``.
+
+    - ``stage_fn(params, x) -> y`` — one stage; activations must keep one
+      shape across stages (standard for transformer blocks).
+    - ``stacked_params``: leading [S] axis (see stack_stage_params).
+    - ``microbatches``: [M, mb, ...] — the caller's batch split into M
+      microbatches.
+
+    Returns outputs [M, mb, ...], replicated (the last stage's results are
+    broadcast back so the loss is computable everywhere). Differentiable.
+    """
+    s = mesh.shape[axis]
+    from jax.experimental.shard_map import shard_map
+
+    def shard_body(params, x_mb):
+        # params: this device's stage slice, leading dim 1 — drop it
+        params = jax.tree.map(lambda p: p[0], params)
+        idx = jax.lax.axis_index(axis)
+        m = x_mb.shape[0]
+        ticks = m + s - 1
+        out0 = jnp.zeros_like(x_mb)
+        recv0 = jnp.zeros_like(x_mb[0])
+        fwd_perm = [(i, i + 1) for i in range(s - 1)]
+
+        def tick(t, carry):
+            recv, out = carry
+            mb_idx = t - idx
+            active = (mb_idx >= 0) & (mb_idx < m)
+            inp = jnp.where(idx == 0, x_mb[jnp.clip(t, 0, m - 1)], recv)
+            y = stage_fn(params, inp)
+            y = jnp.where(active, y, jnp.zeros_like(y))
+            write = (idx == s - 1) & active
+            slot = jnp.clip(mb_idx, 0, m - 1)
+            out = out.at[slot].set(jnp.where(write, y, out[slot]))
+            recv = jax.lax.ppermute(y, axis, fwd_perm)
+            return recv, out
+
+        _, out = jax.lax.fori_loop(0, ticks, tick, (recv0, out0))
+        # broadcast the last stage's outputs to every pipe position so the
+        # caller can compute the loss anywhere: all-reduce of the masked
+        # buffer (only stage S-1 holds nonzeros)
+        out = jnp.where(idx == s - 1, out, jnp.zeros_like(out))
+        return jax.lax.psum(out, axis)
+
+    def fn(stacked_params, microbatches):
+        in_specs = (
+            jax.tree.map(lambda _: P(axis), stacked_params),
+            P(),  # microbatches replicated; stage 0 reads them
+        )
+        return shard_map(
+            shard_body, mesh=mesh, in_specs=in_specs, out_specs=P(),
+            check_rep=False,
+        )(stacked_params, microbatches)
+
+    return fn
+
+
+def pipeline_step(stage_fn: Callable, loss_fn: Callable, mesh: Mesh,
+                  axis: str = "pipe"):
+    """Training-step builder: returns ``step(stacked_params, microbatches,
+    labels_mb) -> (loss, grads)`` with the full fwd+bwd pipeline compiled as
+    one XLA program."""
+    fwd = gpipe(stage_fn, mesh, axis)
+
+    def step(stacked_params, microbatches, labels_mb):
+        def total_loss(p):
+            outs = fwd(p, microbatches)
+            return loss_fn(outs, labels_mb)
+
+        return jax.value_and_grad(total_loss)(stacked_params)
+
+    return step

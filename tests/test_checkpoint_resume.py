@@ -1,0 +1,114 @@
+"""Elastic checkpoint/resume tests (SURVEY §5.3): rotating serials, atomic
+writes (partial checkpoints skipped), and preemption-resume producing
+bit-identical training to an uninterrupted run."""
+
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _build(dim=8, classes=3):
+    x = fluid.layers.data("x", shape=[dim])
+    y = fluid.layers.data("y", shape=[1], dtype="int64")
+    logits = fluid.layers.fc(x, size=classes, param_attr=fluid.ParamAttr(name="w"),
+                             bias_attr=fluid.ParamAttr(name="b"))
+    loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(logits, y))
+    fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+    return loss
+
+
+def _data(rng, n=64, dim=8, classes=3):
+    xs = rng.randn(n, dim).astype("float32")
+    ys = rng.randint(0, classes, (n, 1)).astype("int64")
+    return xs, ys
+
+
+def test_checkpoint_rotation_and_serials(tmp_path, rng):
+    ckpt = str(tmp_path / "ck")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs, ys = _data(rng)
+    for step in range(5):
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        fluid.io.save_checkpoint(exe, ckpt, main, trainer_args={"step": step},
+                                 max_num_checkpoints=3)
+    names = sorted(os.listdir(ckpt))
+    assert names == ["checkpoint_2", "checkpoint_3", "checkpoint_4"], names
+    args = fluid.io.load_checkpoint(exe, ckpt, main)
+    assert args["step"] == 4
+
+
+def test_resume_matches_uninterrupted(tmp_path, rng):
+    xs, ys = _data(rng)
+    ckpt = str(tmp_path / "ck")
+
+    def fresh():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 90210
+        with fluid.program_guard(main, startup):
+            loss = _build()
+        return main, startup, loss
+
+    # uninterrupted: 10 steps
+    main, startup, loss = fresh()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(10):
+            full = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        w_full = fluid.global_scope().as_numpy("w")
+
+    # interrupted at step 5 + resume in a brand-new scope ("new process")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for step in range(5):
+            exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        fluid.io.save_checkpoint(exe, ckpt, main, trainer_args={"step": 5})
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)  # re-init (wrong weights) — then restore
+        args = fluid.io.load_checkpoint(exe, ckpt, main)
+        assert args["step"] == 5
+        for _ in range(5):
+            resumed = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        w_res = fluid.global_scope().as_numpy("w")
+    np.testing.assert_allclose(w_res, w_full, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(float(resumed[0]), float(full[0]), rtol=1e-6)
+
+
+def test_partial_checkpoint_skipped(tmp_path, rng):
+    """A checkpoint dir without the _SUCCESS marker (preempted mid-save)
+    must be ignored in favour of the previous complete one."""
+    ckpt = str(tmp_path / "ck")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs, ys = _data(rng)
+    exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    fluid.io.save_checkpoint(exe, ckpt, main, trainer_args={"step": 0})
+    good_w = fluid.global_scope().as_numpy("w")
+    # simulate a torn write: newer serial without _SUCCESS
+    torn = os.path.join(ckpt, "checkpoint_1")
+    os.makedirs(torn)
+    with open(os.path.join(torn, "garbage"), "w") as f:
+        f.write("x")
+    exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])  # drift weights
+    args = fluid.io.load_checkpoint(exe, ckpt, main)
+    assert args["step"] == 0
+    np.testing.assert_allclose(fluid.global_scope().as_numpy("w"), good_w)
+
+
+def test_no_checkpoint_returns_none(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    assert fluid.io.load_checkpoint(exe, str(tmp_path / "nope"), main) is None
+    fluid.io.clean_checkpoint(str(tmp_path / "nope"))  # no-op, no raise

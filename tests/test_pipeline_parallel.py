@@ -1,0 +1,104 @@
+"""Pipeline parallelism tests on the virtual CPU mesh: GPipe forward and
+fwd+bwd parity against the plain sequential composition of stages."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from paddle_tpu.parallel import gpipe, pipeline_step, stack_stage_params
+
+
+def _mesh(n, axis="pipe"):
+    devs = np.array(jax.devices()[:n])
+    return Mesh(devs, (axis,))
+
+
+def _stage(params, x):
+    w, b = params
+    return jnp.tanh(x @ w + b)
+
+
+def _make(rng, s=4, d=8):
+    per_stage = [(jnp.asarray(rng.randn(d, d).astype("float32") * 0.4),
+                  jnp.asarray(rng.randn(d).astype("float32") * 0.1))
+                 for _ in range(s)]
+    return per_stage, stack_stage_params(per_stage)
+
+
+def test_gpipe_forward_matches_sequential(rng):
+    s, m, mb, d = 4, 6, 3, 8
+    per_stage, stacked = _make(rng, s, d)
+    x = jnp.asarray(rng.randn(m, mb, d).astype("float32"))
+    mesh = _mesh(s)
+    fwd = gpipe(_stage, mesh, "pipe")
+    got = jax.jit(fwd)(stacked, x)
+
+    exp = x
+    for p in per_stage:
+        exp = _stage(p, exp)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(exp), rtol=2e-5, atol=2e-5)
+
+
+def test_gpipe_gradients_match_sequential(rng):
+    s, m, mb, d = 4, 5, 2, 8
+    per_stage, stacked = _make(rng, s, d)
+    x = jnp.asarray(rng.randn(m, mb, d).astype("float32"))
+    y = jnp.asarray(rng.randn(m, mb, d).astype("float32"))
+    mesh = _mesh(s)
+
+    def loss_fn(outs, labels):
+        return jnp.mean((outs - labels) ** 2)
+
+    step = jax.jit(pipeline_step(_stage, loss_fn, mesh, "pipe"))
+    loss_p, grads_p = step(stacked, x, y)
+
+    def seq_loss(st):
+        h = x
+        for i in range(s):
+            h = _stage(jax.tree.map(lambda a: a[i], st), h)
+        return loss_fn(h, y)
+
+    loss_s, grads_s = jax.value_and_grad(seq_loss)(stacked)
+    np.testing.assert_allclose(float(loss_p), float(loss_s), rtol=2e-5)
+    for gp, gs in zip(jax.tree.leaves(grads_p), jax.tree.leaves(grads_s)):
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gs),
+                                   rtol=5e-4, atol=1e-5)
+
+
+def test_gpipe_trains(rng):
+    """A 4-stage pipelined MLP fits a random mapping — end-to-end SGD."""
+    s, m, mb, d = 4, 4, 4, 8
+    per_stage, stacked = _make(rng, s, d)
+    x = jnp.asarray(rng.randn(m, mb, d).astype("float32"))
+    y = jnp.asarray((rng.randn(m, mb, d) * 0.3).astype("float32"))
+    mesh = _mesh(s)
+    step = jax.jit(pipeline_step(_stage, lambda o, l: jnp.mean((o - l) ** 2),
+                                 mesh, "pipe"))
+    params = stacked
+    losses = []
+    for _ in range(25):
+        loss, grads = step(params, x, y)
+        params = jax.tree.map(lambda p, g: p - 0.3 * g, params, grads)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_gpipe_with_params_sharded_on_mesh(rng):
+    """Stage params placed with the pipe sharding still give correct results
+    (each device holds only its stage — the memory-scaling contract)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    s, m, mb, d = 4, 4, 2, 8
+    per_stage, stacked = _make(rng, s, d)
+    mesh = _mesh(s)
+    sh = NamedSharding(mesh, P("pipe"))
+    stacked = jax.tree.map(lambda p: jax.device_put(p, sh), stacked)
+    x = jnp.asarray(rng.randn(m, mb, d).astype("float32"))
+    fwd = gpipe(_stage, mesh, "pipe")
+    got = jax.jit(fwd)(stacked, x)
+    exp = x
+    for p in per_stage:
+        exp = _stage(p, exp)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(exp), rtol=2e-5, atol=2e-5)
