@@ -59,3 +59,7 @@ from .layers.layer_helper import ParamAttr  # noqa: F401
 CUDAPlace = TPUPlace
 
 __version__ = "0.1.0"
+
+from .async_executor import AsyncExecutor  # noqa: F401
+from .data_feed_desc import DataFeedDesc  # noqa: F401
+from .reader.py_reader import EOFException  # noqa: F401

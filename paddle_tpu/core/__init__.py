@@ -18,3 +18,4 @@ from .place import CPUPlace, CUDAPinnedPlace, Place, TPUPlace, is_compiled_with_
 from .registry import OpContext, get_op_impl, has_op, register_op, registered_ops  # noqa: F401
 from .scope import Scope, global_scope, scope_guard  # noqa: F401
 from ..reader.py_reader import EOFException  # noqa: F401  (fluid.core.EOFException parity)
+from .enforce import EnforceNotMet, enforce  # noqa: F401

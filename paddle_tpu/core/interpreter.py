@@ -18,12 +18,21 @@ SKIP_OPS = frozenset({"backward_marker", "feed", "fetch"})
 
 
 def run_block_ops(ops, env: Dict[str, Any], trace, offset: int = 0):
+    from .enforce import EnforceNotMet, wrap_op_error
+
     for i, op in enumerate(ops):
         if op.type in SKIP_OPS:
             continue
         trace.current_op_idx = offset + i
         impl = get_op_impl(op.type)
-        impl(OpContext(op, env, trace))
+        try:
+            impl(OpContext(op, env, trace))
+        except EnforceNotMet:
+            raise  # already enriched (nested blocks)
+        except NotImplementedError:
+            raise  # registry gap message is already the good pattern
+        except Exception as e:
+            raise wrap_op_error(e, op, offset + i, env) from e
 
 
 class PerStepTrace:

@@ -1,0 +1,174 @@
+"""AsyncExecutor / CTR ingestion tests: MultiSlot text parsing, DataFeedDesc
+proto-text parsing, multi-threaded file training end to end (a DeepFM-style
+sparse+dense CTR model reaches decreasing loss), dataset family smoke, and
+strategy/enforce UX contracts."""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+PROTO = """
+name: "MultiSlotDataFeed"
+batch_size: 8
+multi_slot_desc {
+  slots {
+    name: "ids"
+    type: "uint64"
+    is_dense: false
+    is_used: true
+  }
+  slots {
+    name: "dense"
+    type: "float"
+    is_dense: true
+    is_used: true
+  }
+  slots {
+    name: "label"
+    type: "float"
+    is_dense: true
+    is_used: true
+  }
+}
+"""
+
+
+def _write_ctr_files(tmp_path, rng, n_files=3, lines_per_file=64, vocab=100, dense_dim=4):
+    """CTR rule: label = sigmoid-ish of whether any id < vocab/4 plus dense[0]."""
+    files = []
+    for fi in range(n_files):
+        fn = str(tmp_path / ("part-%d.txt" % fi))
+        with open(fn, "w") as f:
+            for _ in range(lines_per_file):
+                k = rng.randint(1, 6)
+                ids = rng.randint(0, vocab, size=k)
+                dense = rng.randn(dense_dim).astype("float32")
+                y = 1.0 if (ids < vocab // 4).any() or dense[0] > 0.5 else 0.0
+                line = "%d %s %d %s 1 %.1f" % (
+                    k, " ".join(map(str, ids)),
+                    dense_dim, " ".join("%.4f" % v for v in dense), y)
+                f.write(line + "\n")
+        files.append(fn)
+    return files
+
+
+def test_data_feed_desc_parses_proto_text(tmp_path):
+    p = tmp_path / "feed.proto"
+    p.write_text(PROTO)
+    desc = fluid.DataFeedDesc(str(p))
+    assert desc.name == "MultiSlotDataFeed"
+    assert desc.batch_size == 8
+    assert [s.name for s in desc.slots] == ["ids", "dense", "label"]
+    assert [s.is_dense for s in desc.slots] == [False, True, True]
+    desc.set_batch_size(16)
+    assert desc.batch_size == 16
+    desc.set_use_slots(["ids", "label"])
+    assert [s.is_used for s in desc.slots] == [True, False, True]
+    assert "MultiSlotDataFeed" in desc.desc()
+
+
+def test_async_executor_trains_ctr(tmp_path, rng):
+    vocab, dense_dim = 100, 4
+    files = _write_ctr_files(tmp_path, rng)
+    p = tmp_path / "feed.proto"
+    p.write_text(PROTO)
+    desc = fluid.DataFeedDesc(str(p))
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[-1], dtype="int64")   # [B, L]
+        ids_len = fluid.layers.data("ids_length", shape=[], dtype="int64")
+        dense = fluid.layers.data("dense", shape=[dense_dim])
+        label = fluid.layers.data("label", shape=[1])
+        emb = fluid.layers.embedding(ids, size=[vocab, 8])
+        pooled = fluid.layers.sequence.sequence_pool(emb, "average", length=ids_len)
+        h = fluid.layers.concat([pooled, dense], axis=1)
+        h = fluid.layers.fc(h, size=16, act="relu")
+        pred = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.sigmoid_cross_entropy_with_logits(pred, label))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    async_exe = fluid.AsyncExecutor(fluid.CPUPlace())
+    r1 = async_exe.run(main, desc, files, thread_num=3, fetch=[loss.name])
+    assert len(r1) == 3 * 64 // 8
+    first_epoch = np.mean([float(v[0]) for v in r1])
+    for _ in range(4):
+        rl = async_exe.run(main, desc, files, thread_num=2, fetch=[loss.name])
+    last_epoch = np.mean([float(v[0]) for v in rl])
+    assert np.isfinite(last_epoch)
+    assert last_epoch < first_epoch, (first_epoch, last_epoch)
+
+
+def test_async_executor_propagates_parse_errors(tmp_path, rng):
+    bad = tmp_path / "bad.txt"
+    bad.write_text("3 1 2\n")  # declares 3 values, provides 2
+    p = tmp_path / "feed.proto"
+    p.write_text(PROTO)
+    desc = fluid.DataFeedDesc(str(p))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[-1], dtype="int64",
+                                append_batch_size=False)
+        out = fluid.layers.cast(ids, "float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with pytest.raises(ValueError, match="declares 3 values"):
+        fluid.AsyncExecutor().run(main, desc, [str(bad)], thread_num=1,
+                                  fetch=[out.name])
+
+
+def test_dataset_family_smoke():
+    from paddle_tpu.dataset import conll05, imdb, imikolov, movielens, wmt16
+
+    seq, y = next(imdb.train()())
+    assert isinstance(seq, list) and y in (0, 1)
+    gram = next(imikolov.train(n=5)())
+    assert len(gram) == 5
+    rec = next(conll05.train()())
+    words = rec[0]
+    assert len(rec) == 9 and len(rec[8]) == len(words)
+    src, trg, trg_next = next(wmt16.train()())
+    assert trg[0] == wmt16.BOS and trg_next[-1] == wmt16.EOS
+    assert len(trg) == len(trg_next)
+    row = next(movielens.train()())
+    assert len(row) == 8 and 1.0 <= row[-1][0] <= 5.0
+    assert len(imdb.word_dict()) == imdb.VOCAB
+
+
+def test_strategy_knobs_warn_when_inert():
+    es = fluid.ExecutionStrategy()
+    with pytest.warns(UserWarning, match="no effect"):
+        es.num_threads = 8
+    bs = fluid.BuildStrategy()
+    with pytest.warns(UserWarning, match="GSPMD"):
+        bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+    # honored knob must NOT warn
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        bs.gradient_accumulation_steps = 4
+
+
+def test_enforce_error_carries_op_context(rng):
+    """A failing op impl surfaces as EnforceNotMet naming op/inputs/attrs."""
+    from paddle_tpu.core import EnforceNotMet
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[6])
+        # elementwise on incompatible shapes → impl-level failure at trace
+        bad = fluid.layers.elementwise_add(x, y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    with pytest.raises(EnforceNotMet, match="elementwise_add"):
+        exe.run(main, feed={"x": rng.randn(2, 4).astype("float32"),
+                            "y": rng.randn(2, 6).astype("float32")},
+                fetch_list=[bad])

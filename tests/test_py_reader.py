@@ -157,6 +157,8 @@ def test_py_reader_requires_start(rng):
     reader.decorate_tensor_provider(lambda: iter([]))
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
-    # not started → vars simply aren't fed → clear error from tracing
-    with pytest.raises(KeyError):
+    # not started → vars simply aren't fed → context-rich tracing error
+    from paddle_tpu.core import EnforceNotMet
+
+    with pytest.raises(EnforceNotMet, match="not materialized"):
         exe.run(main, fetch_list=[loss])
