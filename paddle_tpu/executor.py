@@ -204,18 +204,29 @@ class _CompiledStep:
                     # Gradient accumulation (the reference's multi_batch_merge
                     # pass, ir/multi_batch_merge_pass.cc): split the feed batch
                     # into microbatches, average grads before the optimizer.
-                    grads = None
-                    loss_sum = None
-                    for i in range(accum):
-                        sub = {
-                            n: v.reshape((accum, v.shape[0] // accum) + v.shape[1:])[i]
-                            for n, v in feeds.items()
-                        }
-                        (li, env), gi = jax.value_and_grad(
+                    # lax.scan keeps trace size and compile time CONSTANT in
+                    # accumulation_steps (one traced microbatch, not N); the
+                    # first microbatch runs outside the scan to seed the
+                    # carry structure (grads + the activation env post_ops
+                    # read from).
+                    mb = {
+                        n: v.reshape((accum, v.shape[0] // accum) + v.shape[1:])
+                        for n, v in feeds.items()
+                    }
+                    sub0 = {n: v[0] for n, v in mb.items()}
+                    (loss_sum, env), grads = jax.value_and_grad(
+                        fwd, has_aux=True)(params, {}, sub0)
+
+                    def _mb_step(carry, sub):
+                        g_acc, l_acc, _ = carry
+                        (li, env_i), gi = jax.value_and_grad(
                             fwd, has_aux=True)(params, {}, sub)
-                        grads = gi if grads is None else jax.tree_util.tree_map(
-                            jnp.add, grads, gi)
-                        loss_sum = li if loss_sum is None else loss_sum + li
+                        g_acc = jax.tree_util.tree_map(jnp.add, g_acc, gi)
+                        return (g_acc, l_acc + li, env_i), None
+
+                    (grads, loss_sum, env), _ = jax.lax.scan(
+                        _mb_step, (grads, loss_sum, env),
+                        {n: v[1:] for n, v in mb.items()})
                     grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
                     env[loss_name] = loss_sum / accum
                 # restore fp32 master params for the optimizer ops (the env

@@ -7,6 +7,7 @@ from . import (  # noqa: F401
     compare_ops,
     control_flow_ops,
     detection_ops,
+    extra_ops,
     loss_ops,
     math_ops,
     metric_ops,

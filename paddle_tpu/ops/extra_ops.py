@@ -1,0 +1,335 @@
+"""Op-parity sweep batch (reference files noted per op): the remaining
+generally-useful forward ops from the reference's operator inventory that
+had no TPU implementation yet.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import OpContext, register_op
+
+
+@register_op("add_position_encoding")
+def add_position_encoding_op(ctx: OpContext):
+    """reference: operators/add_position_encoding_op.cc — sinusoidal PE
+    scaled into the input: out = alpha·x + beta·PE."""
+    x = ctx.input("X")  # [B, T, D]
+    alpha = float(ctx.attr("alpha", 1.0))
+    beta = float(ctx.attr("beta", 1.0))
+    b, t, d = x.shape
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    half = d // 2
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+    pe = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=1)
+    if pe.shape[1] < d:
+        pe = jnp.pad(pe, ((0, 0), (0, d - pe.shape[1])))
+    ctx.set_output("Out", alpha * x + beta * pe[None].astype(x.dtype))
+
+
+@register_op("affine_grid")
+def affine_grid_op(ctx: OpContext):
+    """reference: operators/affine_grid_op.cc — theta [N, 2, 3] → sampling
+    grid [N, H, W, 2] in [-1, 1] coords (pairs with grid_sampler for STN)."""
+    theta = ctx.input("Theta")
+    if ctx.has_input("OutputShape"):
+        shp = ctx.input("OutputShape")
+        n, _, h, w = (int(s) for s in np.asarray(shp))
+    else:
+        n, _, h, w = ctx.attr("output_shape")
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)      # [H*W, 3]
+    out = jnp.einsum("nij,pj->npi", theta.astype(jnp.float32), base)
+    ctx.set_output("Output", out.reshape(theta.shape[0], h, w, 2).astype(theta.dtype))
+
+
+@register_op("modified_huber_loss")
+def modified_huber_loss_op(ctx: OpContext):
+    """reference: operators/modified_huber_loss_op.cc (labels {0,1} → ±1)."""
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    t = 2.0 * y.astype(jnp.float32) - 1.0
+    z = x.astype(jnp.float32) * t
+    loss = jnp.where(z < -1.0, -4.0 * z,
+                     jnp.where(z < 1.0, jnp.square(1.0 - z), 0.0))
+    ctx.set_output("IntermediateVal", z.astype(x.dtype))
+    ctx.set_output("Out", loss.astype(x.dtype))
+
+
+@register_op("teacher_student_sigmoid_loss")
+def teacher_student_sigmoid_loss_op(ctx: OpContext):
+    """reference: operators/teacher_student_sigmoid_loss_op.cc — CTR
+    distillation loss over a blended teacher/student label."""
+    x = ctx.input("X").astype(jnp.float32).reshape(-1)
+    label = ctx.input("Label").astype(jnp.float32).reshape(-1)
+    # label packing (teacher_student_sigmoid_loss_op.h:38): -2 = no-teacher
+    # no-click; -1 = no-teacher click; [0,1) = teacher-q no-click;
+    # [1,2] = 1 + teacher-q, click.
+    relu_x = jnp.maximum(x, 0.0)
+    softplus = jnp.log1p(jnp.exp(-jnp.abs(x)))
+    bce = relu_x + softplus           # -log(1 - sigmoid(x))·… the z=0 case
+    bce_click = relu_x - x + softplus  # z=1 case
+    loss = jnp.where(
+        label < -1.0, bce,
+        jnp.where(label < 0.0, bce_click,
+        jnp.where(label < 1.0, bce + relu_x - x * label + softplus,
+                  bce_click + relu_x - x * (label - 1.0) + softplus)))
+    ctx.set_output("Y", loss.reshape(-1, 1).astype(ctx.input("X").dtype))
+
+
+@register_op("sampling_id")
+def sampling_id_op(ctx: OpContext):
+    """reference: operators/sampling_id_op.cc — sample one column index per
+    row of a probability matrix."""
+    x = ctx.input("X")
+    key = ctx.rng()
+    ids = jax.random.categorical(key, jnp.log(jnp.maximum(x, 1e-20)), axis=-1)
+    ctx.set_output("Out", ids.astype(jnp.int64))
+
+
+@register_op("random_crop")
+def random_crop_op(ctx: OpContext):
+    """reference: operators/random_crop_op.cc — crop the trailing dims to
+    ``shape`` at a random offset (train) / center (test)."""
+    x = ctx.input("X")
+    shape = [int(s) for s in ctx.attr("shape")]
+    nd = len(shape)
+    lead = x.ndim - nd
+    key = ctx.rng()
+    starts = []
+    for i, s in enumerate(shape):
+        limit = x.shape[lead + i] - s
+        if ctx.is_test or limit <= 0:
+            starts.append(limit // 2 if limit > 0 else 0)
+        else:
+            key, sub = jax.random.split(key)
+            starts.append(jax.random.randint(sub, (), 0, limit + 1))
+    out = jax.lax.dynamic_slice(
+        x, tuple([0] * lead) + tuple(starts), tuple(x.shape[:lead]) + tuple(shape))
+    ctx.set_output("Out", out)
+
+
+@register_op("sequence_conv")
+def sequence_conv_op(ctx: OpContext):
+    """reference: operators/sequence_conv_op.cc — conv over the time axis
+    with a context window. X [B, T, D] (+ Length), Filter
+    [ctx_len·D, filters]."""
+    x = ctx.input("X")
+    filt = ctx.input("Filter")
+    length = ctx.input("Length")
+    ctx_len = int(ctx.attr("contextLength", 3))
+    ctx_start = int(ctx.attr("contextStart", -(ctx_len // 2)))
+    b, t, d = x.shape
+    # mask padding positions so context windows don't leak across Length
+    if length is not None:
+        mask = (jnp.arange(t)[None, :] < length.astype(jnp.int32)[:, None])
+        x = jnp.where(mask[..., None], x, 0.0)
+    cols = []
+    for k in range(ctx_len):
+        off = ctx_start + k
+        cols.append(jnp.roll(x, -off, axis=1) * (
+            ((jnp.arange(t) + off >= 0) & (jnp.arange(t) + off < t))
+            [None, :, None].astype(x.dtype)))
+    ctx_mat = jnp.concatenate(cols, axis=-1)            # [B, T, ctx_len*D]
+    ctx.set_output("Out", jnp.einsum("btc,cf->btf", ctx_mat, filt))
+
+
+@register_op("sequence_reshape")
+def sequence_reshape_op(ctx: OpContext):
+    """reference: operators/sequence_reshape_op.cc — re-chunk the feature
+    dim: [B, T, D] → [B, T·D/new_dim, new_dim] (padded convention keeps the
+    batch axis; Length scales by D/new_dim)."""
+    x = ctx.input("X")
+    new_dim = int(ctx.attr("new_dim"))
+    b, t, d = x.shape
+    ctx.set_output("Out", x.reshape(b, t * d // new_dim, new_dim))
+    length = ctx.input("Length")
+    if length is not None:
+        ctx.set_output("OutLength", (length * d) // new_dim)
+
+
+@register_op("spectral_norm")
+def spectral_norm_op(ctx: OpContext):
+    """reference: operators/spectral_norm_op.cc — weight / sigma_max via
+    power iteration on persistent U/V vectors."""
+    w = ctx.input("Weight")
+    u = ctx.input("U").reshape(-1)
+    v = ctx.input("V").reshape(-1)
+    dim = int(ctx.attr("dim", 0))
+    power_iters = int(ctx.attr("power_iters", 1))
+    eps = float(ctx.attr("eps", 1e-12))
+    perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+    mat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)  # [H, WflatRest]
+
+    def it(_, uv):
+        u_, v_ = uv
+        v_ = mat.T @ u_
+        v_ = v_ / (jnp.linalg.norm(v_) + eps)
+        u_ = mat @ v_
+        u_ = u_ / (jnp.linalg.norm(u_) + eps)
+        return u_, v_
+
+    u, v = jax.lax.fori_loop(0, power_iters, it, (u, v))
+    sigma = u @ (mat @ v)
+    ctx.set_output("Out", w / sigma)
+    ctx.set_output("UOut", u)
+    ctx.set_output("VOut", v)
+
+
+@register_op("conv_shift")
+def conv_shift_op(ctx: OpContext):
+    """reference: operators/conv_shift_op.cc — circular correlation
+    (NTM-style shift): X [B, D], Y [B, M] (M odd) → [B, D]."""
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    b, d = x.shape
+    m = y.shape[1]
+    half = m // 2
+    out = jnp.zeros_like(x)
+    for j in range(m):
+        out = out + jnp.roll(x, half - j, axis=1) * y[:, j:j + 1]
+    ctx.set_output("Out", out)
+
+
+@register_op("similarity_focus")
+def similarity_focus_op(ctx: OpContext):
+    """reference: operators/similarity_focus_op.cc — for each selected
+    channel, mark the (h, w) argmax-per-row/col pattern with 1."""
+    x = ctx.input("X")  # [B, C, H, W]
+    axis = int(ctx.attr("axis", 1))
+    indexes = [int(i) for i in ctx.attr("indexes")]
+    if axis != 1:
+        raise NotImplementedError("similarity_focus: only axis=1 (channel)")
+    b, c, h, w = x.shape
+    out = jnp.zeros_like(x)
+    for ci in indexes:
+        ch = x[:, ci]                                 # [B, H, W]
+        row_max = ch == jnp.max(ch, axis=2, keepdims=True)
+        col_max = ch == jnp.max(ch, axis=1, keepdims=True)
+        mark = (row_max | col_max).astype(x.dtype)    # [B, H, W]
+        out = out + mark[:, None, :, :] * (jnp.arange(c)[None, :, None, None] == ci)
+    ctx.set_output("Out", jnp.minimum(out, 1.0))
+
+
+@register_op("fused_embedding_seq_pool")
+def fused_embedding_seq_pool_op(ctx: OpContext):
+    """reference: operators/fused_embedding_seq_pool_op.cc — lookup + sum
+    pool in one op (XLA fuses it anyway; kept for graph parity).
+    Ids [B, L] + Length → [B, D]."""
+    w = ctx.input("W")
+    ids = ctx.input("Ids").astype(jnp.int32)
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    length = ctx.input("Length")
+    emb = w[ids]                                      # [B, L, D]
+    if length is not None:
+        mask = (jnp.arange(ids.shape[1])[None, :]
+                < length.astype(jnp.int32)[:, None])[..., None]
+        emb = jnp.where(mask, emb, 0.0)
+    ctx.set_output("Out", jnp.sum(emb, axis=1))
+
+
+@register_op("max_pool3d_with_index")
+def max_pool3d_with_index_op(ctx: OpContext):
+    """reference: pool_with_index_op.cc 3-D variant."""
+    x = ctx.input("X")  # [N, C, D, H, W]
+    ksize = list(ctx.attr("ksize", [2, 2, 2]))
+    strides = list(ctx.attr("strides", ksize))
+    n, c, d, h, w = x.shape
+    kd, kh, kw = ksize
+    sd, sh, sw = strides
+    od, oh, ow = (d - kd) // sd + 1, (h - kh) // sh + 1, (w - kw) // sw + 1
+    iz = (jnp.arange(od) * sd)[:, None, None, None, None, None] + \
+        jnp.arange(kd)[None, None, None, :, None, None]
+    iy = (jnp.arange(oh) * sh)[None, :, None, None, None, None] + \
+        jnp.arange(kh)[None, None, None, None, :, None]
+    ix = (jnp.arange(ow) * sw)[None, None, :, None, None, None] + \
+        jnp.arange(kw)[None, None, None, None, None, :]
+    shp = (od, oh, ow, kd, kh, kw)
+    iz, iy, ix = (jnp.broadcast_to(a, shp) for a in (iz, iy, ix))
+    vals = x[:, :, iz, iy, ix].reshape(n, c, od, oh, ow, -1)
+    out = jnp.max(vals, axis=-1)
+    arg = jnp.argmax(vals, axis=-1)
+    az = arg // (kh * kw)
+    ay = (arg // kw) % kh
+    ax = arg % kw
+    gz = (jnp.arange(od) * sd)[None, None, :, None, None] + az
+    gy = (jnp.arange(oh) * sh)[None, None, None, :, None] + ay
+    gx = (jnp.arange(ow) * sw)[None, None, None, None, :] + ax
+    ctx.set_output("Out", out)
+    ctx.set_output("Mask", (gz * h * w + gy * w + gx).astype(jnp.int32))
+
+
+@register_op("lod_reset")
+def lod_reset_op(ctx: OpContext):
+    """reference: operators/lod_reset_op.cc — under the padded+Length
+    convention this swaps the Length descriptor: data passes through, the
+    new per-row lengths come from Y (or the target_lod attr)."""
+    x = ctx.input("X")
+    ctx.set_output("Out", x)
+    y = ctx.input("Y")
+    if y is not None:
+        ctx.set_output("OutLength", y)
+    else:
+        tl = ctx.attr("target_lod", [])
+        lens = jnp.diff(jnp.asarray(tl, jnp.int32))
+        ctx.set_output("OutLength", lens)
+
+
+@register_op("fill")
+def fill_op(ctx: OpContext):
+    """reference: operators/fill_op.cc — fill with an explicit value list."""
+    from ..core.dtypes import convert_dtype, to_jnp_dtype
+
+    shape = [int(s) for s in ctx.attr("shape")]
+    dtype = to_jnp_dtype(convert_dtype(ctx.attr("dtype", "float32")))
+    value = ctx.attr("value")
+    ctx.set_output("Out", jnp.asarray(value, dtype).reshape(shape))
+
+
+@register_op("average_accumulates")
+def average_accumulates_op(ctx: OpContext):
+    """reference: operators/average_accumulates_op.cc — the running sums
+    behind the ModelAverage optimizer: three cascaded accumulators with
+    window rollover."""
+    param = ctx.input("Param")
+    sum1 = ctx.input("InSum1")
+    sum2 = ctx.input("InSum2")
+    sum3 = ctx.input("InSum3")
+    num_acc = ctx.input("InNumAccumulates").reshape(()).astype(jnp.int64)
+    old_num = ctx.input("InOldNumAccumulates").reshape(()).astype(jnp.int64)
+    num_upd = ctx.input("InNumUpdates").reshape(()).astype(jnp.int64)
+    avg_window = float(ctx.attr("average_window", 0.0))
+    max_avg = int(ctx.attr("max_average_window", 10000))
+    min_avg = int(ctx.attr("min_average_window", 10000))
+
+    k_max_acc = 16384  # reference kMaxNumAccumulates (precision spill)
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    sum1 = sum1 + param
+    spill = num_upd % k_max_acc == 0
+    sum2 = jnp.where(spill, sum2 + sum1, sum2)
+    sum1 = jnp.where(spill, jnp.zeros_like(sum1), sum1)
+    # window rollover (average_accumulates_op.h:57): current window done →
+    # it BECOMES sum3 (discarding the previous sum3), counts shift.
+    window = jnp.minimum(
+        jnp.asarray(max_avg, jnp.int64),
+        (num_upd.astype(jnp.float32) * avg_window).astype(jnp.int64))
+    roll = (num_acc >= min_avg) & (num_acc >= window)
+    sum3 = jnp.where(roll, sum1 + sum2, sum3)
+    sum1 = jnp.where(roll, jnp.zeros_like(sum1), sum1)
+    sum2 = jnp.where(roll, jnp.zeros_like(sum2), sum2)
+    old_num = jnp.where(roll, num_acc, old_num)
+    num_acc = jnp.where(roll, jnp.zeros((), jnp.int64), num_acc)
+
+    ctx.set_output("OutSum1", sum1)
+    ctx.set_output("OutSum2", sum2)
+    ctx.set_output("OutSum3", sum3)
+    ctx.set_output("OutNumAccumulates", num_acc.reshape(1))
+    ctx.set_output("OutOldNumAccumulates", old_num.reshape(1))
+    ctx.set_output("OutNumUpdates", num_upd.reshape(1))

@@ -42,6 +42,7 @@ __all__ = [
     "FtrlOptimizer",
     "LambOptimizer",
     "LarsMomentumOptimizer",
+    "ModelAverage",
     "Optimizer",
 ]
 
@@ -518,6 +519,93 @@ class LambOptimizer(AdamOptimizer):
 
     def _extra_attrs(self):
         return {"weight_decay": self._weight_decay}
+
+
+class ModelAverage(Optimizer):
+    """Averaged-weights evaluation (reference: optimizer.py ModelAverage +
+    operators/average_accumulates_op.cc): appends running-sum accumulate ops
+    after the optimize ops; ``apply()`` temporarily swaps params for their
+    window average, ``restore()`` (or leaving the context) swaps back."""
+
+    type = "model_average"
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, **kw):
+        super().__init__(0.0, **kw)
+        self.average_window = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        self.params_grads = []
+        self._backup = {}
+        main = default_main_program()
+        self.helper = LayerHelper(self.__class__.__name__)
+        for param in main.global_block.all_parameters():
+            if not param.trainable:
+                continue
+            self._append_average_accumulate_op(param)
+            self.params_grads.append(param)
+
+    def _append_average_accumulate_op(self, param):
+        block = default_main_program().global_block
+        sum1 = self._add_accumulator("sum_1", param)
+        sum2 = self._add_accumulator("sum_2", param)
+        sum3 = self._add_accumulator("sum_3", param)
+        n_acc = self._add_accumulator("num_accumulates", param, dtype="int64", shape=[1])
+        old_n = self._add_accumulator("old_num_accumulates", param, dtype="int64", shape=[1])
+        n_upd = self._add_accumulator("num_updates", param, dtype="int64", shape=[1])
+        block.append_op(
+            "average_accumulates",
+            inputs={"Param": param, "InSum1": sum1, "InSum2": sum2,
+                    "InSum3": sum3, "InNumAccumulates": n_acc,
+                    "InOldNumAccumulates": old_n, "InNumUpdates": n_upd},
+            outputs={"OutSum1": sum1, "OutSum2": sum2, "OutSum3": sum3,
+                     "OutNumAccumulates": n_acc, "OutOldNumAccumulates": old_n,
+                     "OutNumUpdates": n_upd},
+            attrs={"average_window": self.average_window,
+                   "max_average_window": self.max_average_window,
+                   "min_average_window": self.min_average_window})
+
+    def _averaged(self, scope, param):
+        import numpy as _np
+
+        g = lambda acc: _np.asarray(scope.find_var(
+            self._accumulators[acc][param.name].name), dtype=_np.float64)
+        total = g("sum_1") + g("sum_2") + g("sum_3")
+        n = float(g("num_accumulates").reshape(())) + float(
+            g("old_num_accumulates").reshape(()))
+        return (total / max(n, 1.0)).astype("float32")
+
+    def apply(self, executor=None, need_restore=True):
+        """Context manager: params ← window average (reference:
+        optimizer.py ModelAverage.apply)."""
+        import contextlib
+
+        from .core.scope import global_scope
+
+        @contextlib.contextmanager
+        def _ctx():
+            scope = global_scope()
+            self._backup = {}
+            import numpy as _np
+
+            for p in self.params_grads:
+                self._backup[p.name] = _np.asarray(scope.find_var(p.name))
+                scope.set_var(p.name, self._averaged(scope, p))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore(executor)
+
+        return _ctx()
+
+    def restore(self, executor=None):
+        from .core.scope import global_scope
+
+        scope = global_scope()
+        for name, val in self._backup.items():
+            scope.set_var(name, val)
+        self._backup = {}
 
 
 # Fluid-style short aliases

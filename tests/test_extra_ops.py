@@ -1,0 +1,227 @@
+"""Tests for the op-parity sweep batch (ops/extra_ops.py) + ModelAverage."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.layers.nn import LayerHelper
+
+
+def _op(op_type, inputs, attrs=None, out_slots=("Out",), dtypes=None):
+    helper = LayerHelper(op_type)
+    outs = {}
+    for i, s in enumerate(out_slots):
+        outs[s] = helper.create_variable_for_type_inference(
+            (dtypes or {}).get(s, "float32"))
+    helper.append_op(op_type, inputs=inputs, outputs=outs, attrs=attrs or {})
+    vals = [outs[s] for s in out_slots]
+    return vals[0] if len(vals) == 1 else vals
+
+
+def _run(fetch, feed):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe.run(feed=feed, fetch_list=fetch if isinstance(fetch, list) else [fetch])
+
+
+def test_add_position_encoding(rng):
+    x_np = np.zeros((2, 6, 8), "float32")
+    x = fluid.layers.data("x", shape=[6, 8])
+    out = _op("add_position_encoding", {"X": x}, {"alpha": 1.0, "beta": 1.0})
+    o, = _run(out, {"x": x_np})
+    half = 4
+    div = 10000.0 ** (np.arange(half) / half)
+    pos = np.arange(6)[:, None]
+    pe = np.concatenate([np.sin(pos / div), np.cos(pos / div)], 1)
+    np.testing.assert_allclose(o[0], pe, rtol=1e-5, atol=1e-6)
+
+
+def test_affine_grid_identity_pairs_with_grid_sampler(rng):
+    """Identity theta → identity grid → grid_sampler returns the input."""
+    x_np = rng.randn(1, 2, 5, 5).astype("float32")
+    theta_np = np.array([[[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]], "float32")
+    x = fluid.layers.data("x", shape=[2, 5, 5])
+    th = fluid.layers.data("th", shape=[2, 3])
+    grid = _op("affine_grid", {"Theta": th},
+               {"output_shape": [1, 2, 5, 5]}, out_slots=("Output",))
+    out = fluid.layers.grid_sampler(x, grid)
+    o, = _run(out, {"x": x_np, "th": theta_np})
+    np.testing.assert_allclose(o, x_np, rtol=1e-5, atol=1e-5)
+
+
+def test_modified_huber_loss(rng):
+    x_np = np.array([[-2.0], [-0.5], [0.5], [2.0]], "float32")
+    y_np = np.array([[1.0], [1.0], [1.0], [1.0]], "float32")
+    x = fluid.layers.data("x", shape=[1])
+    y = fluid.layers.data("y", shape=[1])
+    out = _op("modified_huber_loss", {"X": x, "Y": y},
+              out_slots=("IntermediateVal", "Out"))[1]
+    o, = _run(out, {"x": x_np, "y": y_np})
+    np.testing.assert_allclose(o[:, 0], [8.0, 2.25, 0.25, 0.0], rtol=1e-5)
+
+
+def test_teacher_student_sigmoid_loss(rng):
+    x_np = rng.randn(6, 1).astype("float32")
+    labels = np.array([[-2.0], [-1.0], [0.3], [0.9], [1.2], [2.0]], "float32")
+    x = fluid.layers.data("x", shape=[1])
+    y = fluid.layers.data("y", shape=[1])
+    out = _op("teacher_student_sigmoid_loss", {"X": x, "Label": y},
+              out_slots=("Y",))
+    o, = _run(out, {"x": x_np, "y": labels})
+
+    def ref(xv, lv):
+        r = max(xv, 0.0)
+        sp = np.log1p(np.exp(-abs(xv)))
+        if lv < -1:
+            return r + sp
+        if lv < 0:
+            return r - xv + sp
+        if lv < 1:
+            return (r + sp) + (r - xv * lv + sp)
+        return (r - xv + sp) + (r - xv * (lv - 1.0) + sp)
+
+    exp = [ref(float(x_np[i, 0]), float(labels[i, 0])) for i in range(6)]
+    np.testing.assert_allclose(o[:, 0], exp, rtol=1e-5)
+
+
+def test_sampling_id_distribution(rng):
+    probs = np.tile(np.array([[0.0, 0.0, 1.0, 0.0]], "float32"), (64, 1))
+    x = fluid.layers.data("x", shape=[4])
+    out = _op("sampling_id", {"X": x}, dtypes={"Out": "int64"})
+    o, = _run(out, {"x": probs})
+    np.testing.assert_array_equal(o, np.full(64, 2))
+
+
+def test_random_crop_shapes_and_determinism_in_test_mode(rng):
+    x_np = rng.randn(2, 3, 10, 10).astype("float32")
+    x = fluid.layers.data("x", shape=[3, 10, 10])
+    out = _op("random_crop", {"X": x}, {"shape": [8, 8]})
+    o, = _run(out, {"x": x_np})
+    assert o.shape == (2, 3, 8, 8)
+    # crop content must be a contiguous window of the input
+    found = any(np.allclose(o[0, 0], x_np[0, 0, i:i + 8, j:j + 8])
+                for i in range(3) for j in range(3))
+    assert found
+
+
+def test_sequence_conv_window(rng):
+    b, t, d, f = 2, 6, 4, 5
+    x_np = rng.randn(b, t, d).astype("float32")
+    w_np = rng.randn(3 * d, f).astype("float32")
+    lens = np.array([6, 4], "int64")
+    x = fluid.layers.data("x", shape=[t, d])
+    w = fluid.layers.data("w", shape=[3 * d, f], append_batch_size=False)
+    ln = fluid.layers.data("ln", shape=[], dtype="int64")
+    out = _op("sequence_conv", {"X": x, "Filter": w, "Length": ln},
+              {"contextLength": 3, "contextStart": -1})
+    o, = _run(out, {"x": x_np, "w": w_np, "ln": lens})
+    # manual: row t = [x[t-1], x[t], x[t+1]] @ w with zero pad + length mask
+    xm = x_np.copy()
+    xm[1, 4:] = 0.0
+    for bi in range(b):
+        for ti in range(t):
+            ctx = np.concatenate([
+                xm[bi, ti - 1] if ti - 1 >= 0 else np.zeros(d),
+                xm[bi, ti],
+                xm[bi, ti + 1] if ti + 1 < t else np.zeros(d)])
+            np.testing.assert_allclose(o[bi, ti], ctx @ w_np, rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_reshape(rng):
+    x_np = rng.randn(2, 4, 6).astype("float32")
+    x = fluid.layers.data("x", shape=[4, 6])
+    out = _op("sequence_reshape", {"X": x}, {"new_dim": 3})
+    o, = _run(out, {"x": x_np})
+    np.testing.assert_allclose(o, x_np.reshape(2, 8, 3))
+
+
+def test_spectral_norm_normalizes(rng):
+    w_np = rng.randn(6, 8).astype("float32") * 3
+    u0 = rng.randn(6).astype("float32")
+    v0 = rng.randn(8).astype("float32")
+    w = fluid.layers.data("w", shape=[6, 8], append_batch_size=False)
+    u = fluid.layers.data("u", shape=[6], append_batch_size=False)
+    v = fluid.layers.data("v", shape=[8], append_batch_size=False)
+    out = _op("spectral_norm", {"Weight": w, "U": u, "V": v},
+              {"power_iters": 20}, out_slots=("Out", "UOut", "VOut"))[0]
+    o, = _run(out, {"w": w_np, "u": u0, "v": v0})
+    sigma = np.linalg.svd(w_np, compute_uv=False)[0]
+    np.testing.assert_allclose(np.linalg.svd(o, compute_uv=False)[0], 1.0, rtol=1e-3)
+    np.testing.assert_allclose(o * sigma, w_np, rtol=1e-2, atol=1e-2)
+
+
+def test_conv_shift_circular(rng):
+    x_np = rng.randn(2, 8).astype("float32")
+    y_np = rng.randn(2, 3).astype("float32")
+    x = fluid.layers.data("x", shape=[8])
+    y = fluid.layers.data("y", shape=[3])
+    out = _op("conv_shift", {"X": x, "Y": y})
+    o, = _run(out, {"x": x_np, "y": y_np})
+    exp = np.zeros_like(x_np)
+    for j in range(3):
+        exp += np.roll(x_np, 1 - j, axis=1) * y_np[:, j:j + 1]
+    np.testing.assert_allclose(o, exp, rtol=1e-5)
+
+
+def test_fused_embedding_seq_pool(rng):
+    w_np = rng.randn(20, 4).astype("float32")
+    ids = np.array([[1, 2, 3], [4, 5, 0]], "int64")
+    lens = np.array([3, 2], "int64")
+    w = fluid.layers.data("w", shape=[20, 4], append_batch_size=False)
+    i = fluid.layers.data("i", shape=[3], dtype="int64")
+    ln = fluid.layers.data("ln", shape=[], dtype="int64")
+    out = _op("fused_embedding_seq_pool", {"W": w, "Ids": i, "Length": ln})
+    o, = _run(out, {"w": w_np, "i": ids, "ln": lens})
+    np.testing.assert_allclose(o[0], w_np[[1, 2, 3]].sum(0), rtol=1e-5)
+    np.testing.assert_allclose(o[1], w_np[[4, 5]].sum(0), rtol=1e-5)
+
+
+def test_max_pool3d_with_index(rng):
+    x_np = rng.randn(1, 2, 4, 4, 4).astype("float32")
+    x = fluid.layers.data("x", shape=[2, 4, 4, 4])
+    out, mask = _op("max_pool3d_with_index", {"X": x}, {"ksize": [2, 2, 2]},
+                    out_slots=("Out", "Mask"), dtypes={"Mask": "int32"})
+    o, m = _run([out, mask], {"x": x_np})
+    exp = x_np.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(3, 5, 7))
+    np.testing.assert_allclose(o, exp)
+    flat = x_np.reshape(1, 2, -1)
+    got_via_mask = np.take_along_axis(flat, m.reshape(1, 2, -1), axis=2)
+    np.testing.assert_allclose(got_via_mask.reshape(o.shape), o)
+
+
+def test_fill_op():
+    out = _op("fill", {}, {"shape": [2, 3], "dtype": "float32",
+                           "value": [1, 2, 3, 4, 5, 6]})
+    o, = _run(out, {})
+    np.testing.assert_allclose(o, [[1, 2, 3], [4, 5, 6]])
+
+
+def test_model_average_apply_restore(rng):
+    dim = 4
+    xs = rng.randn(32, dim).astype("float32")
+    ys = (xs @ rng.randn(dim, 1)).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[dim])
+        y = fluid.layers.data("y", shape=[1])
+        pred = fluid.layers.fc(x, size=1, param_attr=fluid.ParamAttr(name="w"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+        ma = fluid.optimizer.ModelAverage(
+            0.5, min_average_window=2, max_average_window=100)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    snaps = []
+    for _ in range(6):
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        snaps.append(fluid.global_scope().as_numpy("w").copy())
+    current = fluid.global_scope().as_numpy("w").copy()
+    with ma.apply(exe):
+        averaged = fluid.global_scope().as_numpy("w").copy()
+    # restored afterwards
+    np.testing.assert_allclose(fluid.global_scope().as_numpy("w"), current)
+    # the average differs from the endpoint and lies inside the visited range
+    assert not np.allclose(averaged, current)
+    lo = np.minimum.reduce(snaps)
+    hi = np.maximum.reduce(snaps)
+    assert (averaged >= lo - 1e-5).all() and (averaged <= hi + 1e-5).all()
