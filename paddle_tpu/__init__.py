@@ -28,6 +28,7 @@ from . import (  # noqa: F401
     initializer,
     io,
     layers,
+    log,
     metrics,
     optimizer,
     parallel,

@@ -1,0 +1,22 @@
+"""Seq2seq decoding helpers (reference: contrib/decoder/beam_search_decoder.py).
+
+The reference builds decoding from StateCell/TrainingDecoder/
+BeamSearchDecoder classes over LoD beam ops. Here decoding is the batched
+beam machinery in ``paddle_tpu.layers.beam_search`` (fixed-capacity
+TensorArray + while-loop decode, verified against a numpy beam search in
+tests/test_beam_search.py); this namespace re-exports it under the contrib
+path for API discovery parity.
+"""
+
+from ...layers.beam_search import (  # noqa: F401
+    array_length,
+    array_read,
+    array_to_tensor,
+    array_write,
+    beam_search,
+    beam_search_decode,
+    create_array,
+)
+
+__all__ = ["beam_search", "beam_search_decode", "create_array", "array_write",
+           "array_read", "array_length", "array_to_tensor"]

@@ -1,0 +1,11 @@
+"""Model-compression namespace (reference: contrib/slim/ — quantization,
+distillation, pruning behind a Compressor config).
+
+Quantization is real (see quantization/): the graph passes delegate to the
+QuantizeTranspiler machinery (contrib/quantize) over Program IR. The
+reference's distillation/pruning strategies are config-driven wrappers over
+ordinary layers (losses + mask ops) — compose them directly; there is no
+hidden runtime to port.
+"""
+
+from . import quantization  # noqa: F401

@@ -1,0 +1,8 @@
+from .quantization_pass import (  # noqa: F401
+    QuantizationFreezePass,
+    QuantizationTransformPass,
+    ConvertToInt8Pass,
+)
+
+__all__ = ["QuantizationTransformPass", "QuantizationFreezePass",
+           "ConvertToInt8Pass"]

@@ -1,0 +1,62 @@
+"""Pass-styled quantization API (reference:
+contrib/slim/quantization/quantization_pass.py — QuantizationTransformPass,
+QuantizationFreezePass, ConvertToInt8Pass over IrGraph).
+
+Our IR is the Program itself, so each pass applies the corresponding phase
+of the QuantizeTranspiler (contrib/quantize/quantize_transpiler.py) — same
+rewrites, pass-shaped interface.
+"""
+
+from __future__ import annotations
+
+from ...quantize.quantize_transpiler import QuantizeTranspiler
+
+__all__ = ["QuantizationTransformPass", "QuantizationFreezePass",
+           "ConvertToInt8Pass"]
+
+
+class QuantizationTransformPass:
+    """reference: quantization_pass.py QuantizationTransformPass."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", window_size=10000,
+                 moving_rate=0.9):
+        self._t = QuantizeTranspiler(
+            weight_bits=weight_bits, activation_bits=activation_bits,
+            activation_quantize_type=activation_quantize_type,
+            weight_quantize_type=weight_quantize_type,
+            window_size=window_size, moving_rate=moving_rate)
+        self._scope = scope
+        self._place = place
+
+    def apply(self, program, startup_program=None):
+        """Insert fake quant/dequant around quantizable ops (QAT)."""
+        return self._t.training_transpile(program, startup_program)
+
+
+class QuantizationFreezePass:
+    """reference: quantization_pass.py QuantizationFreezePass."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="abs_max"):
+        self._t = QuantizeTranspiler(
+            weight_bits=weight_bits, activation_bits=activation_bits,
+            weight_quantize_type=weight_quantize_type)
+        self._scope = scope
+        self._place = place
+
+    def apply(self, program):
+        return self._t.freeze_program(program, self._place, self._scope)
+
+
+class ConvertToInt8Pass:
+    """reference: quantization_pass.py ConvertToInt8Pass."""
+
+    def __init__(self, scope=None, place=None):
+        self._t = QuantizeTranspiler()
+        self._scope = scope
+        self._place = place
+
+    def apply(self, program):
+        return self._t.convert_to_int8(program, self._place, self._scope)

@@ -391,6 +391,11 @@ class Executor:
         )
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
+            from .log import vlog
+
+            vlog(1, "Executor: compiling new step specialization "
+                    "(program v%s, %d feeds, fetch=%s, test=%s)",
+                 program._version, len(feed_sig), list(fetch_names), is_test)
             compiled = _CompiledStep(
                 program,
                 tuple(sorted(feeds)),

@@ -32,7 +32,9 @@ from .attention import multi_head_attention, scaled_dot_product_attention  # noq
 from .rnn import dynamic_lstm, dynamic_lstmp, dynamic_gru, lstm, lstm_unit, gru_unit  # noqa: F401
 from .control_flow import (  # noqa: F401
     DynamicRNN,
+    IfElse,
     StaticRNN,
+    Switch,
     While,
     cond,
     equal,

@@ -462,3 +462,157 @@ class StaticRNN:
     @property
     def final_states(self):
         return self._final_states
+
+
+class IfElse:
+    """Per-row two-branch routing (reference: control_flow.py:1264).
+
+    The reference physically splits rows by the bool mask
+    (split_lod_tensor), runs each branch on its subset, and merges
+    (merge_lod_tensor) — data-dependent shapes. The TPU-native redesign
+    computes BOTH branches over the full batch and blends rows with the
+    mask: identical row-wise results, fully static shapes, and XLA prunes
+    whatever a branch doesn't contribute to. Same API:
+
+        ie = fluid.layers.IfElse(cond)         # cond: [N, 1] bool
+        with ie.true_block():
+            ie.output(f(ie.input(x)))
+        with ie.false_block():
+            ie.output(g(ie.input(x)))
+        out, = ie()
+    """
+
+    OUT, IN_TRUE, IN_FALSE = 0, 1, 2
+
+    def __init__(self, cond: Variable, name: Optional[str] = None):
+        if not isinstance(cond, Variable):
+            raise TypeError("cond must be a Variable")
+        self.helper = LayerHelper("ifelse", name=name)
+        self.cond = cond
+        self.status = IfElse.OUT
+        self.output_table = ([], [])  # (false_outs, true_outs)
+
+    def input(self, x: Variable) -> Variable:
+        if self.status == IfElse.OUT:
+            raise ValueError("IfElse.input() must be called inside a block")
+        return x  # both branches see the full rows; the mask blends later
+
+    @contextlib.contextmanager
+    def _block(self, is_true: bool):
+        if self.status != IfElse.OUT:
+            raise ValueError("cannot nest IfElse blocks")
+        self.status = IfElse.IN_TRUE if is_true else IfElse.IN_FALSE
+        try:
+            yield
+        finally:
+            self.status = IfElse.OUT
+
+    def true_block(self):
+        return self._block(True)
+
+    def false_block(self):
+        return self._block(False)
+
+    def output(self, *outs):
+        if self.status == IfElse.OUT:
+            raise ValueError("output() can only be invoked inside a block")
+        table = self.output_table[1 if self.status == IfElse.IN_TRUE else 0]
+        table.extend(outs)
+
+    def __call__(self):
+        if self.status != IfElse.OUT:
+            raise ValueError("IfElse() must be called outside the blocks")
+        false_outs, true_outs = self.output_table
+        if not false_outs and not true_outs:
+            raise ValueError("invoke true_block/false_block before __call__")
+        if not false_outs or not true_outs:
+            return list(true_outs or false_outs)
+        if len(false_outs) != len(true_outs):
+            raise ValueError("branches produced different output counts")
+        from . import tensor as tensor_layers
+        from .nn import elementwise_add, elementwise_mul
+
+        res = []
+        for fv, tv in zip(false_outs, true_outs):
+            mask = tensor_layers.cast(self.cond, tv.dtype)  # [N, 1]
+            keep = tensor_layers.scale(mask, scale=-1.0, bias=1.0)
+            res.append(elementwise_add(elementwise_mul(tv, mask),
+                                       elementwise_mul(fv, keep)))
+        return res
+
+
+class Switch:
+    """First-matching-case execution (reference: control_flow.py Switch —
+    the LR-schedule workhorse). Each case body is captured into a sub-block
+    and executed under ``conditional_block`` with an effective condition
+    ``case_cond AND NOT any_earlier_match``; vars it writes carry out, the
+    false branch keeps their previous values.
+
+        with fluid.layers.Switch() as switch:
+            with switch.case(step < warmup):
+                fluid.layers.assign(lr_warm, lr)
+            with switch.default():
+                fluid.layers.assign(lr_base, lr)
+    """
+
+    def __init__(self, name: Optional[str] = None):
+        self.helper = LayerHelper("switch", name=name)
+        self._inside = False
+        self._matched: Optional[Variable] = None  # running "already taken"
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    @contextlib.contextmanager
+    def _case(self, condition: Optional[Variable]):
+        if self._inside:
+            raise ValueError("cannot nest Switch cases")
+        self._inside = True
+        program = default_main_program()
+        parent = program.current_block()
+        sub = program._create_block()
+        try:
+            yield
+        finally:
+            program._rollback()
+            self._inside = False
+        written = []
+        for op in sub.ops:
+            for n in op.output_arg_names:
+                if n not in sub.vars and n not in written:
+                    written.append(n)
+        from . import tensor as tensor_layers
+
+        if self._matched is None:
+            self._matched = tensor_layers.fill_constant([1], "bool", False)
+        if condition is None:  # default: runs iff nothing matched yet
+            eff = logical_not(self._matched)
+            new_matched = None
+        else:
+            eff = logical_and(condition, logical_not(self._matched))
+            new_matched = logical_or(self._matched, condition)
+        # identity false-branch: carry vars keep their previous values
+        false_blk = program._create_block()
+        program._rollback()
+        for n in written:
+            false_blk.append_op("assign", inputs={"X": n}, outputs={"Out": n})
+        parent.append_op(
+            "conditional_block",
+            inputs={"Cond": eff},
+            outputs={"Out": written},
+            attrs={"true_block": sub.idx, "false_block": false_blk.idx},
+        )
+        if new_matched is not None:
+            self._matched = new_matched
+
+    def case(self, condition: Variable):
+        return self._case(condition)
+
+    def default(self):
+        return self._case(None)
+
+
+__all__ += ["IfElse", "Switch"]

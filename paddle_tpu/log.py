@@ -1,0 +1,40 @@
+"""VLOG-style logging (reference: glog VLOG(n) used throughout
+paddle/fluid C++; controlled by the GLOG_v env var).
+
+``vlog(level, msg)`` emits when ``GLOG_v >= level`` (same env contract as
+the reference); ``get_logger`` returns a stdlib logger under the
+``paddle_tpu`` namespace for structured use.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+__all__ = ["get_logger", "vlog", "vlog_level"]
+
+_root = logging.getLogger("paddle_tpu")
+if not _root.handlers:
+    h = logging.StreamHandler(sys.stderr)
+    h.setFormatter(logging.Formatter(
+        "%(asctime)s %(name)s %(levelname)s %(message)s", "%H:%M:%S"))
+    _root.addHandler(h)
+    _root.setLevel(logging.INFO)
+
+
+def vlog_level() -> int:
+    try:
+        return int(os.environ.get("GLOG_v", "0"))
+    except ValueError:
+        return 0
+
+
+def get_logger(name: str = "") -> logging.Logger:
+    return _root.getChild(name) if name else _root
+
+
+def vlog(level: int, msg: str, *args):
+    """reference: VLOG(level) << ... — prints iff GLOG_v >= level."""
+    if vlog_level() >= level:
+        _root.info("[VLOG%d] " + msg, level, *args)

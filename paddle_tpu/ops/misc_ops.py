@@ -95,3 +95,14 @@ def py_func_op(ctx: OpContext):
     else:
         outs = call_fwd(*xs)
     ctx.set_outputs("Out", outs)
+
+
+@register_op("delete_var")
+def delete_var_op(ctx: OpContext):
+    """reference: operators/controlflow/... delete_var frees scope tensors;
+    XLA buffer liveness already reclaims dead values inside the compiled
+    step, so this drops the env entries (symbolic no-op kept for program
+    parity)."""
+    for names in ctx.op.inputs.values():
+        for n in names:
+            ctx.env.pop(n, None)

@@ -110,3 +110,55 @@ def test_static_rnn_trains(rng):
     losses = [float(exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])[0])
               for _ in range(30)]
     assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def test_ifelse_row_routing(rng):
+    """IfElse routes rows by mask: rows with label<5 through branch A,
+    others through branch B (reference: control_flow.py:1264 contract)."""
+    import paddle_tpu as fluid
+
+    x_np = rng.randn(8, 4).astype("float32")
+    lab_np = rng.randint(0, 10, (8, 1)).astype("int64")
+    x = fluid.layers.data("x", shape=[4])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    limit = fluid.layers.fill_constant([1], "int64", 5)
+    cond_v = fluid.layers.less_than(label, limit)
+    ie = fluid.layers.IfElse(cond_v)
+    with ie.true_block():
+        ie.output(fluid.layers.scale(ie.input(x), scale=2.0))
+    with ie.false_block():
+        ie.output(fluid.layers.scale(ie.input(x), scale=-1.0))
+    out, = ie()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    o, = exe.run(feed={"x": x_np, "label": lab_np}, fetch_list=[out])
+    exp = np.where(lab_np < 5, x_np * 2.0, x_np * -1.0)
+    np.testing.assert_allclose(o, exp, rtol=1e-6)
+
+
+def test_switch_first_match_wins(rng):
+    """Piecewise-LR-style Switch: first true case assigns, later cases and
+    default are suppressed."""
+    import paddle_tpu as fluid
+
+    step = fluid.layers.data("step", shape=[1], dtype="float32",
+                             append_batch_size=False)
+    lr = fluid.layers.tensor.create_global_var(
+        [1], 0.0, "float32", persistable=True, name="sw_lr")
+    b1 = fluid.layers.fill_constant([1], "float32", 10.0)
+    b2 = fluid.layers.fill_constant([1], "float32", 20.0)
+    with fluid.layers.Switch() as switch:
+        with switch.case(fluid.layers.less_than(step, b1)):
+            fluid.layers.tensor.assign(
+                fluid.layers.fill_constant([1], "float32", 0.1), lr)
+        with switch.case(fluid.layers.less_than(step, b2)):
+            fluid.layers.tensor.assign(
+                fluid.layers.fill_constant([1], "float32", 0.01), lr)
+        with switch.default():
+            fluid.layers.tensor.assign(
+                fluid.layers.fill_constant([1], "float32", 0.001), lr)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    for s, expect in [(5.0, 0.1), (15.0, 0.01), (25.0, 0.001)]:
+        o, = exe.run(feed={"step": np.asarray([s], "float32")}, fetch_list=[lr])
+        assert abs(float(o[0]) - expect) < 1e-7, (s, float(o[0]))

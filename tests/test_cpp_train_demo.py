@@ -1,0 +1,31 @@
+"""C++ train demo: compile train/demo_trainer.cc and run the full
+Python-free training loop (reference: train/demo's CI build+run)."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_cpp_train_demo_compiles_and_converges(tmp_path):
+    prog_dir = str(tmp_path / "demo_program")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    subprocess.run([sys.executable, os.path.join(REPO, "train", "save_program.py"),
+                    prog_dir], check=True, env=env)
+
+    cfg = "python3-config"
+    inc = subprocess.check_output([cfg, "--includes"], text=True).split()
+    ld = subprocess.check_output([cfg, "--ldflags", "--embed"], text=True).split()
+    exe = str(tmp_path / "demo_trainer")
+    subprocess.run(["g++", "-O2", os.path.join(REPO, "train", "demo_trainer.cc"),
+                    *inc, *ld, "-o", exe], check=True)
+
+    r = subprocess.run([exe, prog_dir], env=env, capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, "demo failed:\n%s\n%s" % (r.stdout, r.stderr)
+    assert "C++ train demo: PASS" in r.stdout

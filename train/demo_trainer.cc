@@ -1,0 +1,159 @@
+// C++ training demo — Python-free user code driving paddle_tpu
+// (reference: paddle/fluid/train/demo/demo_trainer.cc, which links
+// libpaddle_fluid and drives Executor::Run from C++).
+//
+// The TPU build's runtime IS the embedded CPython+JAX/XLA stack, so this
+// demo links libpython the way the reference links libpaddle_fluid: all
+// orchestration — program loading, the train loop, synthetic data
+// generation, feed construction, loss extraction, the convergence check —
+// is C++; no Python source is executed beyond the framework itself.
+//
+// Build & run (see train/README.md):
+//   g++ -O2 demo_trainer.cc $(python3-config --includes) \
+//       $(python3-config --ldflags --embed) -o demo_trainer
+//   ./demo_trainer <dir with startup.json/main.json/meta.txt>
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <fstream>
+#include <random>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::string ReadFile(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) {
+    std::fprintf(stderr, "cannot open %s\n", path.c_str());
+    std::exit(2);
+  }
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+PyObject* Check(PyObject* obj, const char* what) {
+  if (obj == nullptr) {
+    std::fprintf(stderr, "python error at: %s\n", what);
+    PyErr_Print();
+    std::exit(3);
+  }
+  return obj;
+}
+
+// Wrap a C++ buffer as a numpy array [rows, cols] of `dtype`.
+PyObject* MakeArray(PyObject* np, void* data, Py_ssize_t bytes,
+                    const char* dtype, int rows, int cols) {
+  PyObject* mv = Check(
+      PyMemoryView_FromMemory(static_cast<char*>(data), bytes, PyBUF_READ),
+      "memoryview");
+  PyObject* flat =
+      Check(PyObject_CallMethod(np, "frombuffer", "Os", mv, dtype), "frombuffer");
+  PyObject* arr =
+      Check(PyObject_CallMethod(flat, "reshape", "(ii)", rows, cols), "reshape");
+  Py_DECREF(mv);
+  Py_DECREF(flat);
+  return arr;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const std::string dir = argc > 1 ? argv[1] : "demo_program";
+
+  Py_Initialize();
+
+  // meta.txt: line 1 = repo path, line 2 = loss var name, line 3 = feature dim
+  std::istringstream meta(ReadFile(dir + "/meta.txt"));
+  std::string repo, loss_name;
+  int dim = 0, classes = 0;
+  std::getline(meta, repo);
+  std::getline(meta, loss_name);
+  meta >> dim >> classes;
+
+  {  // sys.path.insert(0, repo)
+    PyObject* sys_path = Check(PySys_GetObject("path"), "sys.path");
+    PyObject* p = Check(PyUnicode_FromString(repo.c_str()), "repo str");
+    PyList_Insert(sys_path, 0, p);
+    Py_DECREF(p);
+  }
+
+  PyObject* fluid = Check(PyImport_ImportModule("paddle_tpu"), "import paddle_tpu");
+  PyObject* serial = Check(PyImport_ImportModule("paddle_tpu.core.serialization"),
+                           "import serialization");
+  PyObject* np = Check(PyImport_ImportModule("numpy"), "import numpy");
+
+  const std::string startup_json = ReadFile(dir + "/startup.json");
+  const std::string main_json = ReadFile(dir + "/main.json");
+  PyObject* startup = Check(
+      PyObject_CallMethod(serial, "loads", "s", startup_json.c_str()), "loads startup");
+  PyObject* main_prog = Check(
+      PyObject_CallMethod(serial, "loads", "s", main_json.c_str()), "loads main");
+
+  PyObject* place = Check(PyObject_CallMethod(fluid, "CPUPlace", nullptr), "CPUPlace");
+  PyObject* exe = Check(PyObject_CallMethod(fluid, "Executor", "O", place), "Executor");
+  Py_DECREF(Check(PyObject_CallMethod(exe, "run", "O", startup), "run startup"));
+
+  // synthetic separable data, generated in C++ (reference demo feeds
+  // constant fake data; we want a real convergence check)
+  std::mt19937 gen(42);
+  std::normal_distribution<float> noise(0.f, 0.3f);
+  std::vector<float> centers(static_cast<size_t>(classes) * dim);
+  for (auto& c : centers) c = noise(gen) * 10.f;
+
+  const int batch = 32;
+  std::vector<float> xbuf(static_cast<size_t>(batch) * dim);
+  std::vector<long long> ybuf(batch);
+  std::uniform_int_distribution<int> pick(0, classes - 1);
+
+  PyObject* run_name = Check(PyUnicode_FromString("run"), "run name");
+  double first_loss = -1.0, last_loss = -1.0;
+  const int steps = 40;
+  for (int step = 0; step < steps; ++step) {
+    for (int i = 0; i < batch; ++i) {
+      int y = pick(gen);
+      ybuf[i] = y;
+      for (int j = 0; j < dim; ++j)
+        xbuf[static_cast<size_t>(i) * dim + j] =
+            centers[static_cast<size_t>(y) * dim + j] + noise(gen);
+    }
+    PyObject* x_arr = MakeArray(np, xbuf.data(),
+                                static_cast<Py_ssize_t>(xbuf.size() * sizeof(float)),
+                                "float32", batch, dim);
+    PyObject* y_arr = MakeArray(np, ybuf.data(),
+                                static_cast<Py_ssize_t>(ybuf.size() * sizeof(long long)),
+                                "int64", batch, 1);
+    PyObject* feed = Check(PyDict_New(), "feed dict");
+    PyDict_SetItemString(feed, "x", x_arr);
+    PyDict_SetItemString(feed, "y", y_arr);
+    PyObject* fetch = Check(Py_BuildValue("[s]", loss_name.c_str()), "fetch list");
+
+    PyObject* args = Check(Py_BuildValue("(O)", main_prog), "args");
+    PyObject* kwargs = Check(PyDict_New(), "kwargs");
+    PyDict_SetItemString(kwargs, "feed", feed);
+    PyDict_SetItemString(kwargs, "fetch_list", fetch);
+    PyObject* run_m = Check(PyObject_GetAttr(exe, run_name), "exe.run attr");
+    PyObject* result = Check(PyObject_Call(run_m, args, kwargs), "exe.run");
+
+    PyObject* loss0 = Check(PySequence_GetItem(result, 0), "result[0]");
+    PyObject* item = Check(PyObject_CallMethod(loss0, "item", nullptr), "loss.item()");
+    last_loss = PyFloat_AsDouble(item);
+    if (step == 0) first_loss = last_loss;
+    if (step % 10 == 0 || step == steps - 1)
+      std::printf("step %d loss %.6f\n", step, last_loss);
+
+    for (PyObject* o : {x_arr, y_arr, feed, fetch, args, kwargs, run_m, result,
+                        loss0, item})
+      Py_DECREF(o);
+  }
+
+  std::printf("first=%.6f last=%.6f\n", first_loss, last_loss);
+  const bool ok = last_loss < first_loss * 0.5;
+  std::printf(ok ? "C++ train demo: PASS\n" : "C++ train demo: FAIL\n");
+  Py_FinalizeEx();
+  return ok ? 0 : 1;
+}

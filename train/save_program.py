@@ -1,0 +1,39 @@
+"""Build + serialize the train program the C++ demo consumes (reference:
+paddle/fluid/train/demo/README.md step 1 — a python script saves the
+ProgramDesc that demo_trainer.cc loads)."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.core import serialization  # noqa: E402
+
+DIM, CLASSES = 16, 4
+
+
+def main(out_dir):
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup_p.random_seed = 7
+    with fluid.program_guard(main_p, startup_p):
+        x = fluid.layers.data("x", shape=[DIM])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=32, act="relu")
+        logits = fluid.layers.fc(h, size=CLASSES)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "startup.json"), "w") as f:
+        f.write(serialization.dumps(startup_p))
+    with open(os.path.join(out_dir, "main.json"), "w") as f:
+        f.write(serialization.dumps(main_p))
+    with open(os.path.join(out_dir, "meta.txt"), "w") as f:
+        f.write("%s\n%s\n%d %d\n" % (REPO, loss.name, DIM, CLASSES))
+    print("saved to", out_dir)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "demo_program")
