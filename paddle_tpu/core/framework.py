@@ -363,6 +363,8 @@ class Program:
     @random_seed.setter
     def random_seed(self, seed):
         self._seed = int(seed)
+        # seed is baked into compiled steps — invalidate cached specializations
+        self._version += 1
 
     def all_parameters(self) -> List[Parameter]:
         params = []

@@ -52,7 +52,7 @@ def _step_flops(exe, feed):
         if scope.find_var(n) is not None
     }
     feeds = {k: np.asarray(v) for k, v in feed.items()}
-    cost = compiled.fn.lower(state, feeds, jax.random.PRNGKey(0)).compile().cost_analysis()
+    cost = compiled.fn.lower(state, feeds, np.uint32(0)).compile().cost_analysis()
     if isinstance(cost, (list, tuple)):
         cost = cost[0]
     return float(cost["flops"])
