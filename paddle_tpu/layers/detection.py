@@ -394,3 +394,65 @@ def polygon_box_transform(input, name=None):
     helper.append_op("polygon_box_transform", inputs={"Input": input},
                      outputs={"Output": out})
     return out
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var, gt_boxes,
+                      is_crowd=None, im_info=None, rpn_batch_size_per_im=256,
+                      rpn_straddle_thresh=0.0, rpn_fg_fraction=0.5,
+                      rpn_positive_overlap=0.7, rpn_negative_overlap=0.3,
+                      use_random=True):
+    """RPN anchor sampling (reference: detection.py:57 → 
+    rpn_target_assign_op.cc). Static redesign: returns
+    (score_mask [B, A] {-1 ignore, 0 bg, 1 fg}, target_label [B, A],
+    target_bbox [B, A, 4], bbox_inside_weight [B, A, 4]) instead of the
+    reference's ragged gathered index lists; the losses mask with
+    score_mask >= 0 (score) and == 1 (loc)."""
+    helper = LayerHelper("rpn_target_assign")
+    score_mask = helper.create_variable_for_type_inference("int32")
+    tgt_lbl = helper.create_variable_for_type_inference("int32")
+    tgt_bbox = helper.create_variable_for_type_inference(gt_boxes.dtype)
+    inw = helper.create_variable_for_type_inference(gt_boxes.dtype)
+    helper.append_op(
+        "rpn_target_assign",
+        inputs={"Anchor": anchor_box, "GtBoxes": gt_boxes, "IsCrowd": is_crowd,
+                "ImInfo": im_info},
+        outputs={"ScoreMask": score_mask, "TargetLabel": tgt_lbl,
+                 "TargetBBox": tgt_bbox, "BBoxInsideWeight": inw},
+        attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+               "rpn_straddle_thresh": rpn_straddle_thresh,
+               "rpn_fg_fraction": rpn_fg_fraction,
+               "rpn_positive_overlap": rpn_positive_overlap,
+               "rpn_negative_overlap": rpn_negative_overlap,
+               "use_random": use_random})
+    return score_mask, tgt_lbl, tgt_bbox, inw
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes, im_info,
+                             batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.25, bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True):
+    """Second-stage RoI sampling (reference: detection.py:1744)."""
+    helper = LayerHelper("generate_proposal_labels")
+    rois = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    labels = helper.create_variable_for_type_inference("int32")
+    tgts = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    iw = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    ow = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    roiw = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "generate_proposal_labels",
+        inputs={"RpnRois": rpn_rois, "GtClasses": gt_classes,
+                "IsCrowd": is_crowd, "GtBoxes": gt_boxes, "ImInfo": im_info},
+        outputs={"Rois": rois, "LabelsInt32": labels, "BboxTargets": tgts,
+                 "BboxInsideWeights": iw, "BboxOutsideWeights": ow,
+                 "RoiWeights": roiw},
+        attrs={"batch_size_per_im": batch_size_per_im, "fg_fraction": fg_fraction,
+               "fg_thresh": fg_thresh, "bg_thresh_hi": bg_thresh_hi,
+               "bg_thresh_lo": bg_thresh_lo,
+               "bbox_reg_weights": list(bbox_reg_weights),
+               "class_nums": int(class_nums), "use_random": use_random})
+    return rois, labels, tgts, iw, ow, roiw
+
+
+__all__ += ["rpn_target_assign", "generate_proposal_labels"]

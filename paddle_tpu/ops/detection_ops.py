@@ -842,3 +842,190 @@ def generate_proposals_op(ctx: OpContext):
     ctx.set_output("RpnRois", rois)
     ctx.set_output("RpnRoiProbs", probs)
     ctx.set_output("Length", length)
+
+
+# -- two-stage detector training samplers -------------------------------------
+
+
+def _subsample_mask(key, eligible, k):
+    """Pick ≤k True positions from ``eligible`` uniformly at random →
+    bool mask (the reference's ReservoirSampling, made shape-static: rank
+    eligible rows by random scores, keep the first min(k, #eligible))."""
+    n = eligible.shape[0]
+    scores = jnp.where(eligible, jax.random.uniform(key, (n,)), -1.0)
+    n_elig = jnp.sum(eligible.astype(jnp.int32))
+    take = jnp.minimum(n_elig, k)
+    order = jnp.argsort(-scores)
+    rank = jnp.zeros((n,), jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
+    return eligible & (rank < take)
+
+
+@register_op("rpn_target_assign")
+def rpn_target_assign_op(ctx: OpContext):
+    """RPN anchor sampling (reference: detection/rpn_target_assign_op.cc).
+
+    Anchor [A, 4]; GtBoxes [B, Ng, 4] dense (zero-area rows pad); ImInfo
+    [B, 3]. The reference emits variable-length index lists (LocationIndex/
+    ScoreIndex); the static redesign emits per-anchor masks and targets:
+    ScoreMask [B, A] ∈ {-1: ignore, 0: bg sample, 1: fg sample},
+    TargetLabel [B, A], TargetBBox [B, A, 4] (encoded deltas),
+    BBoxInsideWeight [B, A, 4]. Sampling honors rpn_fg_fraction /
+    rpn_batch_size_per_im with use_random.
+    """
+    anchors = ctx.input("Anchor").reshape(-1, 4)
+    gt = ctx.input("GtBoxes")
+    im_info = ctx.input("ImInfo")
+    bs_per_im = int(ctx.attr("rpn_batch_size_per_im", 256))
+    straddle = float(ctx.attr("rpn_straddle_thresh", 0.0))
+    fg_frac = float(ctx.attr("rpn_fg_fraction", 0.5))
+    pos_ov = float(ctx.attr("rpn_positive_overlap", 0.7))
+    neg_ov = float(ctx.attr("rpn_negative_overlap", 0.3))
+    use_random = ctx.attr("use_random", True)
+    base_key = ctx.rng()
+    a = anchors.shape[0]
+    fg_target = int(bs_per_im * fg_frac)
+
+    def one(gt_b, info, key):
+        valid_gt = (gt_b[:, 2] > gt_b[:, 0]) & (gt_b[:, 3] > gt_b[:, 1])
+        h, w = info[0], info[1]
+        inside = ((anchors[:, 0] >= -straddle) & (anchors[:, 1] >= -straddle)
+                  & (anchors[:, 2] < w + straddle) & (anchors[:, 3] < h + straddle)) \
+            if straddle >= 0 else jnp.ones((a,), bool)
+        iou = pairwise_iou(anchors, gt_b, normalized=False)   # [A, Ng]
+        iou = jnp.where(valid_gt[None, :], iou, 0.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        # fg: (a) argmax anchor per gt, (b) iou > pos_ov
+        per_gt_best = jnp.max(jnp.where(inside[:, None], iou, -1.0), axis=0)
+        is_gt_best = jnp.any(
+            (iou == per_gt_best[None, :]) & valid_gt[None, :] & (per_gt_best[None, :] > 0),
+            axis=1)
+        fg_elig = inside & (is_gt_best | (best_iou >= pos_ov))
+        bg_elig = inside & (best_iou < neg_ov) & ~fg_elig
+        k1, k2 = jax.random.split(key)
+        if use_random:
+            fg = _subsample_mask(k1, fg_elig, jnp.asarray(fg_target))
+        else:
+            rank = jnp.cumsum(fg_elig.astype(jnp.int32)) - 1
+            fg = fg_elig & (rank < fg_target)
+        n_fg = jnp.sum(fg.astype(jnp.int32))
+        n_bg = bs_per_im - n_fg
+        if use_random:
+            bg = _subsample_mask(k2, bg_elig, n_bg)
+        else:
+            rank = jnp.cumsum(bg_elig.astype(jnp.int32)) - 1
+            bg = bg_elig & (rank < n_bg)
+        score_mask = jnp.where(fg, 1, jnp.where(bg, 0, -1)).astype(jnp.int32)
+        # encoded regression targets vs matched gt (variance-free, like the
+        # reference's BoxToDelta with weights=1)
+        g = gt_b[best_gt]
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        acx = anchors[:, 0] + aw * 0.5
+        acy = anchors[:, 1] + ah * 0.5
+        gw = g[:, 2] - g[:, 0] + 1.0
+        gh = g[:, 3] - g[:, 1] + 1.0
+        gcx = g[:, 0] + gw * 0.5
+        gcy = g[:, 1] + gh * 0.5
+        tgt = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                         jnp.log(jnp.maximum(gw / aw, 1e-6)),
+                         jnp.log(jnp.maximum(gh / ah, 1e-6))], axis=1)
+        tgt = jnp.where(fg[:, None], tgt, 0.0)
+        inw = jnp.where(fg[:, None], 1.0, 0.0) * jnp.ones((1, 4))
+        return score_mask, fg.astype(jnp.int32), tgt, inw
+
+    b = gt.shape[0]
+    keys = jax.random.split(base_key, b)
+    score_mask, lbl, tgt, inw = jax.vmap(one)(gt, im_info, keys)
+    ctx.set_output("ScoreMask", score_mask)
+    ctx.set_output("TargetLabel", lbl)
+    ctx.set_output("TargetBBox", tgt)
+    ctx.set_output("BBoxInsideWeight", inw)
+
+
+@register_op("generate_proposal_labels")
+def generate_proposal_labels_op(ctx: OpContext):
+    """Second-stage RoI sampling (reference:
+    detection/generate_proposal_labels_op.cc). RpnRois [B, R, 4] (padded
+    -1), GtClasses [B, Ng], GtBoxes [B, Ng, 4] →
+    Rois [B, batch_size_per_im, 4], LabelsInt32 [B, S] (−1 pads),
+    BboxTargets [B, S, 4·C], BboxInsideWeights / BboxOutsideWeights same
+    shape, RoiWeights [B, S] (1 for sampled rows).
+    """
+    rois = ctx.input("RpnRois")
+    gt_classes = ctx.input("GtClasses").astype(jnp.int32)
+    gt_boxes = ctx.input("GtBoxes")
+    bs = int(ctx.attr("batch_size_per_im", 256))
+    fg_frac = float(ctx.attr("fg_fraction", 0.25))
+    fg_thresh = float(ctx.attr("fg_thresh", 0.5))
+    bg_hi = float(ctx.attr("bg_thresh_hi", 0.5))
+    bg_lo = float(ctx.attr("bg_thresh_lo", 0.0))
+    weights = [float(v) for v in ctx.attr("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2])]
+    class_nums = int(ctx.attr("class_nums"))
+    use_random = ctx.attr("use_random", True)
+    base_key = ctx.rng()
+    fg_target = int(bs * fg_frac)
+
+    def one(rois_b, cls_b, gt_b, key):
+        valid_gt = (gt_b[:, 2] > gt_b[:, 0]) & (gt_b[:, 3] > gt_b[:, 1])
+        # candidate set = proposals + gt boxes (the reference concatenates)
+        cand = jnp.concatenate([rois_b, gt_b], axis=0)
+        cand_valid = jnp.concatenate([
+            rois_b[:, 2] > rois_b[:, 0], valid_gt], axis=0)
+        iou = pairwise_iou(cand, gt_b, normalized=False)
+        iou = jnp.where(valid_gt[None, :], iou, 0.0)
+        best = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        fg_elig = cand_valid & (best_iou >= fg_thresh)
+        bg_elig = cand_valid & (best_iou < bg_hi) & (best_iou >= bg_lo)
+        k1, k2 = jax.random.split(key)
+        if use_random:
+            fg = _subsample_mask(k1, fg_elig, jnp.asarray(fg_target))
+        else:
+            fg = fg_elig & (jnp.cumsum(fg_elig.astype(jnp.int32)) - 1 < fg_target)
+        n_fg = jnp.sum(fg.astype(jnp.int32))
+        n_bg = bs - n_fg
+        if use_random:
+            bg = _subsample_mask(k2, bg_elig, n_bg)
+        else:
+            bg = bg_elig & (jnp.cumsum(bg_elig.astype(jnp.int32)) - 1 < n_bg)
+        chosen = fg | bg
+        # pack chosen rows to the front (stable) → fixed S = bs rows
+        order = jnp.argsort(~chosen)          # False<True: chosen first
+        take = order[:bs]
+        sel = chosen[take]
+        out_rois = jnp.where(sel[:, None], cand[take], -1.0)
+        labels = jnp.where(fg[take], cls_b[best[take]], 0)
+        labels = jnp.where(sel, labels, -1).astype(jnp.int32)
+        # encoded targets against matched gt, one-hot per class
+        g = gt_b[best[take]]
+        r = cand[take]
+        rw = r[:, 2] - r[:, 0] + 1.0
+        rh = r[:, 3] - r[:, 1] + 1.0
+        rcx = r[:, 0] + rw * 0.5
+        rcy = r[:, 1] + rh * 0.5
+        gw = jnp.maximum(g[:, 2] - g[:, 0] + 1.0, 1e-6)
+        gh = jnp.maximum(g[:, 3] - g[:, 1] + 1.0, 1e-6)
+        gcx = g[:, 0] + gw * 0.5
+        gcy = g[:, 1] + gh * 0.5
+        wv = jnp.asarray(weights)
+        t = jnp.stack([(gcx - rcx) / rw / wv[0], (gcy - rcy) / rh / wv[1],
+                       jnp.log(gw / rw) / wv[2], jnp.log(gh / rh) / wv[3]], axis=1)
+        is_fg = fg[take] & sel
+        onehot = jax.nn.one_hot(jnp.maximum(labels, 0), class_nums)  # [S, C]
+        t_full = (onehot[:, :, None] * t[:, None, :]).reshape(bs, 4 * class_nums)
+        t_full = jnp.where(is_fg[:, None], t_full, 0.0)
+        iw = jnp.broadcast_to(
+            (onehot * is_fg[:, None])[:, :, None], (bs, class_nums, 4)
+        ).reshape(bs, 4 * class_nums)
+        return out_rois, labels, t_full, iw, sel.astype(jnp.float32)
+
+    b = rois.shape[0]
+    keys = jax.random.split(base_key, b)
+    out_rois, labels, tgts, iw, roiw = jax.vmap(one)(rois, gt_classes, gt_boxes, keys)
+    ctx.set_output("Rois", out_rois)
+    ctx.set_output("LabelsInt32", labels)
+    ctx.set_output("BboxTargets", tgts)
+    ctx.set_output("BboxInsideWeights", iw)
+    ctx.set_output("BboxOutsideWeights", iw)
+    ctx.set_output("RoiWeights", roiw)

