@@ -142,3 +142,97 @@ class CompositeMetric(MetricBase):
 
     def eval(self):
         return [m.eval() for m in self._metrics]
+
+
+class DetectionMAP(MetricBase):
+    """Mean average precision over padded detection outputs (reference:
+    python/paddle/fluid/metrics.py DetectionMAP + detection_map_op.cc).
+
+    ``update(det, det_len, gt)``:
+    - det: [B, K, 6] rows (label, score, x1, y1, x2, y2), -1-padded
+      (multiclass_nms output convention)
+    - det_len: [B] valid counts (the Length output)
+    - gt: [B, Ng, 5] rows (label, x1, y1, x2, y2); zero-area rows pad
+
+    ``eval()`` → mAP with ``ap_version`` 'integral' or '11point'.
+    """
+
+    def __init__(self, name=None, overlap_threshold=0.5, evaluate_difficult=True,
+                 ap_version="integral"):
+        super().__init__(name)
+        if ap_version not in ("integral", "11point"):
+            raise ValueError("ap_version must be 'integral' or '11point'")
+        self.overlap_threshold = overlap_threshold
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self, executor=None, reset_program=None):
+        self._gt_count = {}        # class -> total gt
+        self._records = {}         # class -> list of (score, tp)
+
+    @staticmethod
+    def _iou(a, b):
+        ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+        iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+        inter = ix * iy
+        ua = ((a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1])
+              - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    def update(self, det, det_len, gt):
+        import numpy as np
+
+        det = np.asarray(det)
+        det_len = np.asarray(det_len).astype(int)
+        gt = np.asarray(gt)
+        for b in range(det.shape[0]):
+            gts = [g for g in gt[b] if g[3] > g[1] and g[4] > g[2]]
+            for g in gts:
+                c = int(g[0])
+                self._gt_count[c] = self._gt_count.get(c, 0) + 1
+            used = [False] * len(gts)
+            rows = det[b, :det_len[b]]
+            for lab, score, *box in sorted(rows.tolist(), key=lambda r: -r[1]):
+                c = int(lab)
+                best, best_j = 0.0, -1
+                for j, g in enumerate(gts):
+                    if int(g[0]) != c or used[j]:
+                        continue
+                    ov = self._iou(box, g[1:5])
+                    if ov > best:
+                        best, best_j = ov, j
+                tp = best >= self.overlap_threshold and best_j >= 0
+                if tp:
+                    used[best_j] = True
+                self._records.setdefault(c, []).append((float(score), bool(tp)))
+
+    def eval(self, executor=None):
+        import numpy as np
+
+        aps = []
+        for c, total in self._gt_count.items():
+            recs = sorted(self._records.get(c, []), key=lambda r: -r[0])
+            if total == 0:
+                continue
+            tp_cum = fp_cum = 0
+            precisions, recalls = [], []
+            for _, tp in recs:
+                tp_cum += tp
+                fp_cum += not tp
+                precisions.append(tp_cum / (tp_cum + fp_cum))
+                recalls.append(tp_cum / total)
+            if not recs:
+                aps.append(0.0)
+                continue
+            if self.ap_version == "integral":
+                ap, prev_r = 0.0, 0.0
+                for p, r in zip(precisions, recalls):
+                    ap += p * (r - prev_r)
+                    prev_r = r
+            else:  # 11point
+                ap = 0.0
+                for t in np.linspace(0, 1, 11):
+                    ps = [p for p, r in zip(precisions, recalls) if r >= t]
+                    ap += (max(ps) if ps else 0.0) / 11.0
+            aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0

@@ -105,3 +105,23 @@ def test_device_prefetcher_yields_device_arrays():
 
     assert isinstance(got[0]["x"], jax.Array)
     np.testing.assert_array_equal(np.asarray(got[3]["x"]), feeds[3]["x"])
+
+
+def test_detection_map_metric():
+    """DetectionMAP vs a hand-computed single-class case."""
+    from paddle_tpu.metrics import DetectionMAP
+
+    m = DetectionMAP(overlap_threshold=0.5, ap_version="integral")
+    # 2 gts; 3 detections: hit(0.9), miss(0.8), hit(0.7)
+    gt = np.array([[[1, 0, 0, 10, 10], [1, 20, 20, 30, 30]]], "float32")
+    det = np.array([[[1, 0.9, 0, 0, 10, 10],
+                     [1, 0.8, 50, 50, 60, 60],
+                     [1, 0.7, 20, 20, 30, 30],
+                     [-1, -1, -1, -1, -1, -1]]], "float32")
+    m.update(det, [3], gt)
+    # precisions at recalls: r=.5 p=1.0; r=1.0 p=2/3 → AP = .5*1 + .5*2/3
+    assert abs(m.eval() - (0.5 + 0.5 * 2 / 3)) < 1e-6
+    m11 = DetectionMAP(overlap_threshold=0.5, ap_version="11point")
+    m11.update(det, [3], gt)
+    # max precision ≥ each recall threshold: 1.0 for t<=0.5 (6 pts), 2/3 above
+    assert abs(m11.eval() - (6 * 1.0 + 5 * 2 / 3) / 11) < 1e-6
