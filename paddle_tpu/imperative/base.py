@@ -47,3 +47,25 @@ def to_variable(value, block=None, name=None) -> VarBase:
                            "(inside paddle_tpu.imperative.guard())")
     value = np.asarray(value)
     return VarBase(value, name=name)
+
+
+def save_dygraph(state_or_layer, model_path: str):
+    """Save a Layer's (or dict of VarBase) state to ``model_path``
+    (reference: the dygraph save_persistables / later save_dygraph API)."""
+    import numpy as np
+
+    from .layers import Layer
+
+    state = state_or_layer.state_dict() if isinstance(state_or_layer, Layer) \
+        else dict(state_or_layer)
+    arrays = {name: np.asarray(v.value if hasattr(v, "value") else v)
+              for name, v in state.items()}
+    np.savez(model_path + ".npz", **arrays)
+
+
+def load_dygraph(model_path: str):
+    """→ {name: np.ndarray}; pair with ``Layer.set_state`` below."""
+    import numpy as np
+
+    with np.load(model_path + ".npz") as data:
+        return {k: data[k] for k in data.files}

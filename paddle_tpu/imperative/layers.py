@@ -195,3 +195,23 @@ class PyLayer:
     @classmethod
     def apply(cls, *inputs):
         return trace_fn(cls.forward, *inputs)
+
+
+def _layer_set_state(self, state_dict, strict: bool = True):
+    """Load arrays produced by ``base.save_dygraph`` into this Layer's
+    parameters/state by name."""
+    import jax.numpy as jnp
+
+    own = self.state_dict()
+    missing = [k for k in own if k not in state_dict]
+    unexpected = [k for k in state_dict if k not in own]
+    if strict and (missing or unexpected):
+        raise KeyError("state mismatch: missing=%s unexpected=%s"
+                       % (missing, unexpected))
+    for k, arr in state_dict.items():
+        if k in own:
+            own[k].value = jnp.asarray(arr)
+
+
+Layer.set_state = _layer_set_state
+Layer.load_dict = _layer_set_state

@@ -1,2 +1,16 @@
 from .distribute_transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .memory_optimization import memory_optimize, release_memory  # noqa: F401
+
+
+class InferenceTranspiler:
+    """Compat shim (reference: transpiler/inference_transpiler.py — BN fold,
+    conv+BN fuse, relu fuse for CPU/MKLDNN inference). Under XLA these
+    algebraic fusions happen in the compiler for every jitted program, so
+    transpile is the identity; kept so reference inference scripts run
+    unchanged."""
+
+    def transpile(self, program, place=None, scope=None):
+        return program
+
+
+__all__ = list(globals().get("__all__", [])) + ["InferenceTranspiler"]
