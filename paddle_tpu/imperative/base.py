@@ -14,7 +14,7 @@ from ..core import unique_name
 from . import tracer as tracer_mod
 from .tracer import Tracer, VarBase
 
-__all__ = ["enabled", "guard", "to_variable"]
+__all__ = ["enabled", "guard", "to_variable", "save_dygraph", "load_dygraph"]
 
 
 def enabled() -> bool:
@@ -52,9 +52,7 @@ def to_variable(value, block=None, name=None) -> VarBase:
 def save_dygraph(state_or_layer, model_path: str):
     """Save a Layer's (or dict of VarBase) state to ``model_path``
     (reference: the dygraph save_persistables / later save_dygraph API)."""
-    import numpy as np
-
-    from .layers import Layer
+    from .layers import Layer  # local: layers imports this module's guard
 
     state = state_or_layer.state_dict() if isinstance(state_or_layer, Layer) \
         else dict(state_or_layer)
@@ -64,8 +62,6 @@ def save_dygraph(state_or_layer, model_path: str):
 
 
 def load_dygraph(model_path: str):
-    """→ {name: np.ndarray}; pair with ``Layer.set_state`` below."""
-    import numpy as np
-
+    """→ {name: np.ndarray}; pair with ``Layer.set_state``."""
     with np.load(model_path + ".npz") as data:
         return {k: data[k] for k in data.files}

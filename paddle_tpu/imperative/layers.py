@@ -100,6 +100,28 @@ class Layer:
         for p in self.parameters():
             p.clear_gradient()
 
+    def set_state(self, state_dict, strict: bool = True):
+        """Load arrays produced by ``base.save_dygraph`` into this Layer's
+        parameters/state by name; shapes/dtypes must match."""
+        own = self.state_dict()
+        missing = [k for k in own if k not in state_dict]
+        unexpected = [k for k in state_dict if k not in own]
+        if strict and (missing or unexpected):
+            raise KeyError("state mismatch: missing=%s unexpected=%s"
+                           % (missing, unexpected))
+        for k, arr in state_dict.items():
+            if k not in own:
+                continue
+            arr = jnp.asarray(arr)
+            if tuple(arr.shape) != own[k].shape:
+                raise ValueError(
+                    "state %r has shape %s but parameter expects %s"
+                    % (k, tuple(arr.shape), own[k].shape))
+            own[k].value = arr.astype(own[k].value.dtype)
+
+    # reference-compat alias
+    load_dict = set_state
+
     def train(self):
         t = tracer_mod.current_tracer()
         if t:
@@ -195,23 +217,3 @@ class PyLayer:
     @classmethod
     def apply(cls, *inputs):
         return trace_fn(cls.forward, *inputs)
-
-
-def _layer_set_state(self, state_dict, strict: bool = True):
-    """Load arrays produced by ``base.save_dygraph`` into this Layer's
-    parameters/state by name."""
-    import jax.numpy as jnp
-
-    own = self.state_dict()
-    missing = [k for k in own if k not in state_dict]
-    unexpected = [k for k in state_dict if k not in own]
-    if strict and (missing or unexpected):
-        raise KeyError("state mismatch: missing=%s unexpected=%s"
-                       % (missing, unexpected))
-    for k, arr in state_dict.items():
-        if k in own:
-            own[k].value = jnp.asarray(arr)
-
-
-Layer.set_state = _layer_set_state
-Layer.load_dict = _layer_set_state

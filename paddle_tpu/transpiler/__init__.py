@@ -13,4 +13,10 @@ class InferenceTranspiler:
         return program
 
 
-__all__ = list(globals().get("__all__", [])) + ["InferenceTranspiler"]
+__all__ = [
+    "DistributeTranspiler",
+    "DistributeTranspilerConfig",
+    "InferenceTranspiler",
+    "memory_optimize",
+    "release_memory",
+]

@@ -312,11 +312,13 @@ def test_save_load_dygraph_roundtrip(rng, tmp_path):
     with imperative.guard():
         m2 = MLP("mlp")
         m2(to_variable(x))  # build (different random init)
-        state = load_dygraph(path)
-        # names differ per-guard (unique suffixes) — map by order for the test
-        own = m2.state_dict()
-        assert len(own) == len(state)
-        m2.set_state({k2: state[k1] for k1, k2 in
-                      zip(sorted(state), sorted(own))})
+        # unique_name.guard() resets per imperative.guard(), so names match
+        m2.set_state(load_dygraph(path))
         out2 = m2(to_variable(x)).numpy()
     np.testing.assert_allclose(out1, out2, rtol=1e-6)
+    # strict mode flags shape mismatches loudly
+    with imperative.guard():
+        m3 = MLP("mlp", dim=16)
+        m3(to_variable(np.ones((2, 16), dtype="float32")))
+        with pytest.raises((ValueError, KeyError)):
+            m3.set_state(load_dygraph(path))
