@@ -409,3 +409,16 @@ def run_block_ops_ref(*args, **kw):
     from ..core.interpreter import run_block_ops
 
     return run_block_ops(*args, **kw)
+
+
+# Reference op-name aliases: the reference's layers emit op types "gru" /
+# "lstmp" (gru_op.cc, lstmp_op.cc) for what this framework registers as
+# dynamic_gru / dynamic_lstmp — same math over padded+Length batches.
+@register_op("gru")
+def gru_alias_op(ctx: OpContext):
+    dynamic_gru_op(ctx)
+
+
+@register_op("lstmp")
+def lstmp_alias_op(ctx: OpContext):
+    dynamic_lstmp_op(ctx)

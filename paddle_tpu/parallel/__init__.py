@@ -9,3 +9,4 @@ from .distributed import init_distributed  # noqa: F401
 from .mesh import create_mesh, get_mesh, mesh_guard  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
 from .pipeline import gpipe, pipeline_step, stack_stage_params  # noqa: F401
+from .moe import make_switch_ffn, switch_moe  # noqa: F401

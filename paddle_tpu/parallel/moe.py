@@ -1,0 +1,104 @@
+"""Expert parallelism: Switch-style Mixture-of-Experts over a mesh axis.
+
+The reference has no MoE (SURVEY §3 marks EP absent); this implements the
+TPU-native design directly — the GShard/Switch dispatch formulation:
+top-1 routing → capacity-limited one-hot dispatch tensor → einsum
+dispatch/combine, with experts sharded over an ``expert`` mesh axis inside
+``shard_map`` and tokens exchanged by ``all_to_all`` over ICI. Everything is
+static-shape (capacity padding, dropped-token masking) and differentiable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["switch_moe", "make_switch_ffn"]
+
+
+def _dispatch_tensors(gate_logits, capacity):
+    """gate_logits [N, E] → (dispatch [N, E, C] one-hot, combine [N, E, C],
+    aux_loss). Top-1 routing with per-expert capacity (Switch Transformer
+    semantics: overflow tokens are dropped from the expert but pass through
+    the residual path as zeros here)."""
+    n, e = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                 # [N]
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0     # [N, E], -1 elsewhere
+    pos_in_expert = jnp.sum(pos * onehot, axis=1)       # [N]
+    keep = pos_in_expert < capacity
+    gate = jnp.sum(probs * onehot, axis=1) * keep       # [N]
+    slot = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), capacity,
+                          dtype=jnp.float32)            # [N, C]
+    dispatch = onehot[:, :, None] * slot[:, None, :] * keep[:, None, None]
+    combine = dispatch * gate[:, None, None]
+    # load-balancing auxiliary loss (Switch eq. 4): E * Σ_e f_e · p_e
+    frac_tokens = jnp.mean(onehot, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+    return dispatch, combine, aux
+
+
+def switch_moe(x, gate_w, expert_params, expert_fn: Callable, mesh: Mesh,
+               axis: str = "expert", capacity_factor: float = 1.25):
+    """Top-1 MoE layer, expert-parallel over ``axis``.
+
+    - x [B, T, D] (replicated across the expert axis here; compose with a
+      data axis for dp×ep)
+    - gate_w [D, E]
+    - expert_params: pytree with leading [E, ...] axis, sharded over ``axis``
+      (each device holds its experts)
+    - expert_fn(params_one_expert, tokens [C, D]) -> [C, D]
+
+    Returns (y [B, T, D], aux_loss). Differentiable; all_to_all moves only
+    the capacity-packed token buffers between experts.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    b, t, d = x.shape
+    n = b * t
+    e = gate_w.shape[-1]
+    n_shards = mesh.shape[axis]
+    assert e % n_shards == 0, "experts must divide the expert axis"
+    capacity = max(1, int(capacity_factor * n / e))
+
+    flat = x.reshape(n, d)
+    gate_logits = flat @ gate_w
+    dispatch, combine, aux = _dispatch_tensors(gate_logits, capacity)
+    # token buffers per expert: [E, C, D]
+    expert_in = jnp.einsum("nd,nec->ecd", flat.astype(jnp.float32), dispatch)
+
+    def shard_body(params, buf):
+        # buf arrives [E/n_shards, C, D] for THIS shard's experts
+        return jax.vmap(expert_fn)(jax.tree.map(lambda p: p, params), buf)
+
+    expert_out = shard_map(
+        shard_body, mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(axis), expert_params), P(axis)),
+        out_specs=P(axis), check_rep=False,
+    )(expert_params, expert_in.astype(x.dtype))
+
+    y = jnp.einsum("ecd,nec->nd", expert_out.astype(jnp.float32), combine)
+    return y.reshape(b, t, d).astype(x.dtype), aux.astype(x.dtype)
+
+
+def make_switch_ffn(d_model: int, d_ff: int):
+    """Standard per-expert FFN for switch_moe: params [E, ...] maker + fn."""
+
+    def init(key, n_experts):
+        k1, k2 = jax.random.split(key)
+        s1 = (2.0 / (d_model + d_ff)) ** 0.5
+        return {
+            "w1": jax.random.normal(k1, (n_experts, d_model, d_ff)) * s1,
+            "w2": jax.random.normal(k2, (n_experts, d_ff, d_model)) * s1,
+        }
+
+    def fn(p, tokens):
+        return jax.nn.relu(tokens @ p["w1"]) @ p["w2"]
+
+    return init, fn

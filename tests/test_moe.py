@@ -1,0 +1,80 @@
+"""Expert-parallel Switch-MoE tests on the virtual mesh: sharded execution
+matches the unsharded dense computation of the same routing; gradients flow;
+capacity drops overflow tokens."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.parallel import make_switch_ffn, switch_moe
+
+
+def _mesh(n, axis="expert"):
+    return Mesh(np.array(jax.devices()[:n]), (axis,))
+
+
+def _dense_reference(x, gate_w, params, fn, capacity):
+    """Same routing math, computed without sharding/all_to_all."""
+    from paddle_tpu.parallel.moe import _dispatch_tensors
+
+    b, t, d = x.shape
+    flat = x.reshape(-1, d)
+    dispatch, combine, aux = _dispatch_tensors(flat @ gate_w, capacity)
+    buf = jnp.einsum("nd,nec->ecd", flat.astype(jnp.float32), dispatch)
+    out = jax.vmap(fn)(params, buf.astype(x.dtype))
+    y = jnp.einsum("ecd,nec->nd", out.astype(jnp.float32), combine)
+    return y.reshape(b, t, d).astype(x.dtype), aux
+
+
+def test_switch_moe_matches_dense(rng):
+    e, d, dff, b, t = 4, 8, 16, 2, 12
+    mesh = _mesh(4)
+    init, fn = make_switch_ffn(d, dff)
+    params = init(jax.random.PRNGKey(0), e)
+    gate_w = jnp.asarray(rng.randn(d, e).astype("float32") * 0.5)
+    x = jnp.asarray(rng.randn(b, t, d).astype("float32"))
+    cap = max(1, int(1.25 * b * t / e))
+    y, aux = jax.jit(lambda xx: switch_moe(xx, gate_w, params, fn, mesh))(x)
+    y_ref, aux_ref = _dense_reference(x, gate_w, params, fn, cap)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
+
+
+def test_switch_moe_grads_and_sharded_params(rng):
+    e, d, dff, b, t = 4, 8, 16, 2, 8
+    mesh = _mesh(4)
+    init, fn = make_switch_ffn(d, dff)
+    params = init(jax.random.PRNGKey(1), e)
+    sh = NamedSharding(mesh, P("expert"))
+    params = jax.tree.map(lambda p: jax.device_put(p, sh), params)
+    gate_w = jnp.asarray(rng.randn(d, e).astype("float32") * 0.5)
+    x = jnp.asarray(rng.randn(b, t, d).astype("float32"))
+
+    def loss(p, gw):
+        y, aux = switch_moe(x, gw, p, fn, mesh)
+        return jnp.mean(y ** 2) + 0.01 * aux
+
+    g_p, g_gw = jax.jit(jax.grad(loss, argnums=(0, 1)))(params, gate_w)
+    assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g_p))
+    assert np.isfinite(np.asarray(g_gw)).all()
+    # router must receive gradient through the combine weights
+    assert float(jnp.abs(g_gw).sum()) > 0
+
+
+def test_switch_moe_capacity_drops(rng):
+    """With capacity 1 and all tokens preferring one expert, overflow tokens
+    output zeros (Switch drop semantics)."""
+    e, d, dff, b, t = 2, 4, 8, 1, 6
+    mesh = _mesh(2)
+    init, fn = make_switch_ffn(d, dff)
+    params = init(jax.random.PRNGKey(2), e)
+    # gate forces expert 0 for every token
+    gate_w = jnp.zeros((d, e)).at[:, 0].set(10.0)
+    x = jnp.asarray(np.ones((b, t, d), "float32"))
+    y, _ = jax.jit(lambda xx: switch_moe(xx, gate_w, params, fn, mesh,
+                                         capacity_factor=1.0 / e * 1.0))(x)
+    # capacity = int(1/e * n / e)... compute real: capacity_factor*n/e
+    # here: (0.5 * 6 / 2)=1 → only 1 token served, rest dropped to zeros
+    nonzero_rows = int((np.abs(np.asarray(y).reshape(t, d)).sum(-1) > 1e-6).sum())
+    assert nonzero_rows == 1, nonzero_rows
