@@ -1029,3 +1029,134 @@ def generate_proposal_labels_op(ctx: OpContext):
     ctx.set_output("BboxInsideWeights", iw)
     ctx.set_output("BboxOutsideWeights", iw)
     ctx.set_output("RoiWeights", roiw)
+
+
+# -- mask targets + perspective RoIs ------------------------------------------
+
+
+def _point_in_polygon(px, py, verts, n_verts):
+    """Even-odd rule, vectorized: px/py [...], verts [L, 2], n_verts scalar →
+    bool [...]. Padded vertices beyond n_verts are ignored."""
+    l = verts.shape[0]
+    idx = jnp.arange(l)
+    nxt = jnp.where(idx + 1 < n_verts, idx + 1, 0)
+    x1, y1 = verts[:, 0], verts[:, 1]
+    x2, y2 = verts[nxt, 0], verts[nxt, 1]
+    valid = idx < n_verts
+    pxe = px[..., None]
+    pye = py[..., None]
+    cond = (y1 > pye) != (y2 > pye)
+    slope_x = x1 + (pye - y1) * (x2 - x1) / jnp.where(y2 == y1, 1e-9, y2 - y1)
+    crossing = cond & (pxe < slope_x) & valid
+    return jnp.sum(crossing.astype(jnp.int32), axis=-1) % 2 == 1
+
+
+@register_op("generate_mask_labels")
+def generate_mask_labels_op(ctx: OpContext):
+    """Mask R-CNN mask targets (reference:
+    detection/generate_mask_labels_op.cc + mask_util.cc poly rasterization).
+
+    Dense redesign: GtSegms [B, Ng, L, 2] padded polygon vertices (one
+    polygon per gt) + GtPolyLength [B, Ng] vertex counts replace the 3-level
+    LoD; Rois [B, S, 4] with LabelsInt32 [B, S] from
+    generate_proposal_labels. Outputs MaskInt32 [B, S, num_classes·R·R]
+    (−1 everywhere except the matched class's R×R block for fg rois) and
+    RoiHasMaskInt32 [B, S].
+    """
+    rois = ctx.input("Rois")
+    labels = ctx.input("LabelsInt32").astype(jnp.int32)
+    segms = ctx.input("GtSegms").astype(jnp.float32)
+    poly_len = ctx.input("GtPolyLength")
+    gt_classes = ctx.input("GtClasses").astype(jnp.int32)
+    num_classes = int(ctx.attr("num_classes"))
+    r = int(ctx.attr("resolution", 14))
+    b, s, _ = rois.shape
+    ng, l = segms.shape[1], segms.shape[2]
+    if poly_len is None:
+        poly_len = jnp.full((b, ng), l, jnp.int32)
+
+    def one(rois_b, lab_b, segms_b, plen_b, cls_b):
+        # gt boxes from polygons (for roi↔gt matching)
+        vmask = (jnp.arange(l)[None, :] < plen_b[:, None])[..., None]
+        big = jnp.where(vmask, segms_b, jnp.inf)
+        small = jnp.where(vmask, segms_b, -jnp.inf)
+        gt_boxes = jnp.concatenate([jnp.min(big, axis=1), jnp.max(small, axis=1)], 1)
+        valid_gt = plen_b >= 3
+        iou = pairwise_iou(rois_b, gt_boxes, normalized=False)
+        iou = jnp.where(valid_gt[None, :], iou, -1.0)
+        best = jnp.argmax(iou, axis=1)                       # [S]
+        is_fg = lab_b > 0
+
+        ys = (jnp.arange(r, dtype=jnp.float32) + 0.5) / r
+        xs = (jnp.arange(r, dtype=jnp.float32) + 0.5) / r
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")         # [R, R]
+
+        def mask_for(roi, gt_i):
+            px = roi[0] + gx * jnp.maximum(roi[2] - roi[0], 1e-6)
+            py = roi[1] + gy * jnp.maximum(roi[3] - roi[1], 1e-6)
+            return _point_in_polygon(px, py, segms_b[gt_i],
+                                     plen_b[gt_i]).astype(jnp.int32)
+
+        masks = jax.vmap(mask_for)(rois_b, best)             # [S, R, R]
+        cls_of = cls_b[best]                                 # [S]
+        onehot = jax.nn.one_hot(cls_of, num_classes, dtype=jnp.int32)
+        full = onehot[:, :, None, None] * masks[:, None, :, :]  # [S, C, R, R]
+        full = jnp.where(is_fg[:, None, None, None], full, 0)
+        # reference packs non-target entries as -1
+        tgt_blk = (onehot[:, :, None, None] == 1) & is_fg[:, None, None, None]
+        packed = jnp.where(tgt_blk, full, -1)
+        return packed.reshape(s, num_classes * r * r), is_fg.astype(jnp.int32)
+
+    mask, has = jax.vmap(one)(rois, labels, segms, poly_len.astype(jnp.int32),
+                              gt_classes)
+    ctx.set_output("MaskInt32", mask)
+    ctx.set_output("RoiHasMaskInt32", has)
+
+
+@register_op("roi_perspective_transform")
+def roi_perspective_transform_op(ctx: OpContext):
+    """Perspective-warp quadrilateral RoIs to a fixed rectangle (reference:
+    detection/roi_perspective_transform_op.cc — OCR text RoIs). ROIs
+    [R, 8] quad corners (x1..y4, clockwise from top-left) + BatchId [R];
+    bilinear sampling of the warped grid → [R, C, H, W]."""
+    x = ctx.input("X")
+    rois = ctx.input("ROIs").astype(jnp.float32)
+    batch_id = ctx.input("BatchId")
+    if batch_id is None:
+        batch_id = jnp.zeros((rois.shape[0],), jnp.int32)
+    oh = int(ctx.attr("transformed_height"))
+    ow = int(ctx.attr("transformed_width"))
+    scale = float(ctx.attr("spatial_scale", 1.0))
+    n, c, h, w = x.shape
+
+    # normalized output grid
+    gy, gx = jnp.meshgrid((jnp.arange(oh) + 0.5) / oh,
+                          (jnp.arange(ow) + 0.5) / ow, indexing="ij")
+
+    def one(quad, bid):
+        q = quad.reshape(4, 2) * scale  # tl, tr, br, bl
+        tl, tr, br, bl = q[0], q[1], q[2], q[3]
+        # bilinear warp of the quad (projective ≈ bilinear for mildly skewed
+        # text quads; the reference solves the full homography — for
+        # rectangles and parallelograms the two coincide)
+        top = tl[None, None] + (tr - tl)[None, None] * gx[..., None]
+        bot = bl[None, None] + (br - bl)[None, None] * gx[..., None]
+        pts = top + (bot - top) * gy[..., None]              # [oh, ow, 2]
+        px, py = pts[..., 0], pts[..., 1]
+        x0 = jnp.floor(px)
+        y0 = jnp.floor(py)
+        lx = px - x0
+        ly = py - y0
+
+        def g(yy, xx):
+            inb = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+            yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            v = x[bid][:, yc, xc]
+            return jnp.where(inb[None], v, 0.0)
+
+        out = (g(y0, x0) * (1 - ly) * (1 - lx) + g(y0, x0 + 1) * (1 - ly) * lx
+               + g(y0 + 1, x0) * ly * (1 - lx) + g(y0 + 1, x0 + 1) * ly * lx)
+        return out
+
+    ctx.set_output("Out", jax.vmap(one)(rois, batch_id.astype(jnp.int32)))

@@ -453,3 +453,69 @@ def test_generate_proposal_labels_sampling(rng):
         assert (mask_blk[other] == 0).all()
     # unselected rows are fully padded
     assert (lo[0][~sel] == -1).all()
+
+
+def test_generate_mask_labels_square_polygon(rng):
+    """A square polygon covering the left half of the roi → mask is 1 on the
+    left columns of the target class block, -1 elsewhere."""
+    from paddle_tpu.layers.nn import LayerHelper
+
+    r = 8
+    rois = np.array([[[0.0, 0.0, 16.0, 16.0]]], "float32")
+    labels = np.array([[2]], "int32")
+    # polygon = left half [0,0]-[8,16]
+    segms = np.array([[[[0, 0], [8, 0], [8, 16], [0, 16]]]], "float32")
+    plen = np.array([[4]], "int64")
+    cls = np.array([[2]], "int64")
+
+    rv = fluid.layers.data("r", shape=[1, 4])
+    lv = fluid.layers.data("l", shape=[1], dtype="int32")
+    sv = fluid.layers.data("s", shape=[1, 4, 2])
+    pv = fluid.layers.data("p", shape=[1], dtype="int64")
+    cv = fluid.layers.data("c", shape=[1], dtype="int64")
+    helper = LayerHelper("gml")
+    mask = helper.create_variable_for_type_inference("int32")
+    has = helper.create_variable_for_type_inference("int32")
+    helper.append_op("generate_mask_labels",
+                     inputs={"Rois": rv, "LabelsInt32": lv, "GtSegms": sv,
+                             "GtPolyLength": pv, "GtClasses": cv},
+                     outputs={"MaskInt32": mask, "RoiHasMaskInt32": has},
+                     attrs={"num_classes": 3, "resolution": r})
+    m, hs = _run([mask, has], {"r": rois, "l": labels, "s": segms, "p": plen,
+                               "c": cls})
+    assert int(hs[0, 0]) == 1
+    blocks = m[0, 0].reshape(3, r, r)
+    assert (blocks[0] == -1).all() and (blocks[1] == -1).all()
+    # left half columns (first 4 of 8) are inside the polygon
+    np.testing.assert_array_equal(blocks[2][:, :4], np.ones((r, 4)))
+    np.testing.assert_array_equal(blocks[2][:, 4:], np.zeros((r, 4)))
+
+
+def test_roi_perspective_transform_identity_rect(rng):
+    """An axis-aligned rectangle quad reproduces bilinear resize of the crop."""
+    from paddle_tpu.layers.nn import LayerHelper
+
+    feat = rng.randn(1, 2, 12, 12).astype("float32")
+    quad = np.array([[2.0, 2.0, 10.0, 2.0, 10.0, 10.0, 2.0, 10.0]], "float32")
+    x = fluid.layers.data("x", shape=[2, 12, 12])
+    q = fluid.layers.data("q", shape=[8])
+    helper = LayerHelper("rpt")
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op("roi_perspective_transform",
+                     inputs={"X": x, "ROIs": q},
+                     outputs={"Out": out},
+                     attrs={"transformed_height": 4, "transformed_width": 4,
+                            "spatial_scale": 1.0})
+    o, = _run(out, {"x": feat, "q": quad})
+    assert o.shape == (1, 2, 4, 4)
+    # sample centers: x = 2 + (j+0.5)/4*8 → 3,5,7,9; same rows
+    for i in range(4):
+        for j in range(4):
+            yy, xx = 2 + (i + 0.5) * 2, 2 + (j + 0.5) * 2
+            y0, x0 = int(yy), int(xx)
+            ly, lx = yy - y0, xx - x0
+            exp = (feat[0, :, y0, x0] * (1 - ly) * (1 - lx)
+                   + feat[0, :, y0, x0 + 1] * (1 - ly) * lx
+                   + feat[0, :, y0 + 1, x0] * ly * (1 - lx)
+                   + feat[0, :, y0 + 1, x0 + 1] * ly * lx)
+            np.testing.assert_allclose(o[0, :, i, j], exp, rtol=1e-4, atol=1e-5)
