@@ -50,10 +50,10 @@ def export_stablehlo(
 
     step = _CompiledStep(program, tuple(sorted(feed_names)), tuple(fetch_names),
                          tuple(sorted(state)), is_test=True, jit=False)
-    key = jax.random.PRNGKey(0)
+    step_idx = np.uint32(0)  # the step fn derives its PRNG key internally
 
     def infer_fn(feeds):
-        _, fetches = step.fn(state, feeds, key)
+        _, fetches = step.fn(state, feeds, step_idx)
         return list(fetches)
 
     if batch_polymorphic:

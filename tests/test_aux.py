@@ -125,3 +125,35 @@ def test_detection_map_metric():
     m11.update(det, [3], gt)
     # max precision ≥ each recall threshold: 1.0 for t<=0.5 (6 pts), 2/3 above
     assert abs(m11.eval() - (6 * 1.0 + 5 * 2 / 3) / 11) < 1e-6
+
+
+def test_nets_composites(rng):
+    """fluid.nets helpers compose and run (reference: nets.py)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import nets
+
+    img = fluid.layers.data("img", shape=[3, 16, 16])
+    seq = fluid.layers.data("seq", shape=[10, 8])
+    ln = fluid.layers.data("ln", shape=[], dtype="int64")
+
+    cp = nets.simple_img_conv_pool(img, num_filters=4, filter_size=3,
+                                   pool_size=2, pool_stride=2, act="relu")
+    grp = nets.img_conv_group(img, conv_num_filter=[4, 4], pool_size=2,
+                              conv_act="relu", conv_with_batchnorm=True)
+    sc = nets.sequence_conv_pool(seq, num_filters=6, filter_size=3, length=ln)
+    g = nets.glu(fluid.layers.fc(img, size=8), dim=-1)
+    att = nets.scaled_dot_product_attention(seq, seq, seq, num_heads=2)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    outs = exe.run(feed={
+        "img": rng.randn(2, 3, 16, 16).astype("float32"),
+        "seq": rng.randn(2, 10, 8).astype("float32"),
+        "ln": np.array([10, 7], "int64"),
+    }, fetch_list=[cp, grp, sc, g, att])
+    assert outs[0].shape == (2, 4, 7, 7)
+    assert outs[1].shape[1] == 4
+    assert outs[2].shape == (2, 6)
+    assert outs[3].shape == (2, 4)
+    assert outs[4].shape == (2, 10, 8)
+    assert all(np.isfinite(o).all() for o in outs)

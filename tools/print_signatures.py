@@ -28,6 +28,7 @@ MODULES = [
     "paddle_tpu.clip",
     "paddle_tpu.io",
     "paddle_tpu.metrics",
+    "paddle_tpu.nets",
     "paddle_tpu.reader",
     "paddle_tpu.backward",
     "paddle_tpu.amp",
