@@ -333,3 +333,77 @@ def average_accumulates_op(ctx: OpContext):
     ctx.set_output("OutNumAccumulates", num_acc.reshape(1))
     ctx.set_output("OutOldNumAccumulates", old_num.reshape(1))
     ctx.set_output("OutNumUpdates", num_upd.reshape(1))
+
+
+def _tree_patch_matrices(edges, max_nodes, max_depth):
+    """Host-side tree2col: per-sample [3, Nmax, Nmax] coefficient matrices
+    (eta_t, eta_l, eta_r per patch membership), reference:
+    operators/math/tree2col.cc construct_patch. Runs under pure_callback —
+    tree traversal is data-dependent preprocessing; the conv FLOPs stay on
+    device."""
+    edges = np.asarray(edges)
+    out = np.zeros((edges.shape[0], 3, max_nodes, max_nodes), np.float32)
+    for b in range(edges.shape[0]):
+        adj = {}
+        for u, v in edges[b]:
+            u, v = int(u), int(v)
+            if u == 0 and v == 0:
+                continue
+            adj.setdefault(u, []).append(v)
+            adj.setdefault(v, []).append(u)
+        n_nodes = max((max(adj) if adj else 0), 0)
+        for root in range(1, n_nodes + 1):
+            # iterative DFS matching the reference's stack traversal
+            visited = {root}
+            stack = [(root, 1, 1, 0)]  # (node, index, pclen, depth)
+            patch = [(root, 1, 1, 0)]
+            while stack:
+                node, idx, pclen, depth = stack[-1]
+                progressed = False
+                kids = adj.get(node, [])
+                for i, v in enumerate(kids):
+                    if v not in visited and depth + 1 < max_depth:
+                        visited.add(v)
+                        stack.append((v, i, len(kids), depth + 1))
+                        patch.append((v, i + 1, len(kids), depth + 1))
+                        progressed = True
+                if not progressed:
+                    stack.pop()
+            for node, idx, pclen, depth in patch:
+                eta_t = (max_depth - depth) / max_depth
+                tmp = 0.5 if pclen == 1 else (idx - 1.0) / (pclen - 1.0)
+                eta_l = (1.0 - eta_t) * tmp
+                eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+                # node ids are 1-based; direction order (l, r, t) matches
+                # the Filter's dim-1 layout (tree2col.cc: i*3 -> l, +1 -> r,
+                # +2 -> t)
+                out[b, 0, root - 1, node - 1] += eta_l
+                out[b, 1, root - 1, node - 1] += eta_r
+                out[b, 2, root - 1, node - 1] += eta_t
+    return out
+
+
+@register_op("tree_conv")
+def tree_conv_op(ctx: OpContext):
+    """Tree-based convolution (reference: tree_conv_op.cc, TBCNN).
+
+    NodesVector [B, Nmax, F], EdgeSet [B, E, 2] int32 (1-based node ids,
+    (0,0) rows pad), Filter [F, 3, output_size, num_filters] →
+    Out [B, Nmax, output_size, num_filters]. The traversal runs on host
+    (pure_callback, constant wrt gradients — matching the reference where
+    EdgeSet carries no grad); the batched coefficient-matrix × feature
+    matmuls run on device.
+    """
+    nodes = ctx.input("NodesVector")
+    edges = ctx.input("EdgeSet").astype(jnp.int32)
+    filt = ctx.input("Filter")
+    max_depth = int(ctx.attr("max_depth", 2))
+    b, nmax, f = nodes.shape
+    coef_shape = jax.ShapeDtypeStruct((b, 3, nmax, nmax), np.dtype("float32"))
+    coefs = jax.pure_callback(
+        lambda e: _tree_patch_matrices(e, nmax, max_depth), coef_shape, edges)
+    coefs = jax.lax.stop_gradient(coefs)
+    # patch features per direction: [B, 3, Nmax, F]
+    col = jnp.einsum("bdnm,bmf->bdnf", coefs, nodes.astype(jnp.float32))
+    out = jnp.einsum("bdnf,fdok->bnok", col, filt.astype(jnp.float32))
+    ctx.set_output("Out", out.astype(nodes.dtype))

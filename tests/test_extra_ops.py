@@ -225,3 +225,41 @@ def test_model_average_apply_restore(rng):
     lo = np.minimum.reduce(snaps)
     hi = np.maximum.reduce(snaps)
     assert (averaged >= lo - 1e-5).all() and (averaged <= hi + 1e-5).all()
+
+
+def test_tree_conv_matches_manual(rng):
+    """3-node tree (1-2, 1-3), max_depth 2: patches from each root with the
+    reference eta coefficients (tree2col.cc)."""
+    f, out_sz, k, nmax = 4, 3, 2, 3
+    nodes = rng.randn(1, nmax, f).astype("float32")
+    edges = np.array([[[1, 2], [1, 3], [0, 0]]], "int32")
+    filt = rng.randn(f, 3, out_sz, k).astype("float32")
+
+    nv = fluid.layers.data("nv", shape=[nmax, f])
+    ev = fluid.layers.data("ev", shape=[3, 2], dtype="int32")
+    fv = fluid.layers.data("fv", shape=[f, 3, out_sz, k], append_batch_size=False)
+    out = _op("tree_conv", {"NodesVector": nv, "EdgeSet": ev, "Filter": fv},
+              {"max_depth": 2})
+    o, = _run(out, {"nv": nodes, "ev": edges, "fv": filt})
+    assert o.shape == (1, nmax, out_sz, k)
+
+    # manual: adjacency 1-{2,3}, 2-{1}, 3-{1}; depth<2 → root + children
+    def eta(idx, pclen, depth, d=2.0):
+        et = (d - depth) / d
+        tmp = 0.5 if pclen == 1 else (idx - 1.0) / (pclen - 1.0)
+        el = (1.0 - et) * tmp
+        return el, (1.0 - et) * (1.0 - el), et
+
+    def patch_row(members):
+        col = np.zeros((3, f))
+        for node, idx, pclen, depth in members:
+            el, er, et = eta(idx, pclen, depth)
+            col[0] += el * nodes[0, node - 1]
+            col[1] += er * nodes[0, node - 1]
+            col[2] += et * nodes[0, node - 1]
+        return np.einsum("df,fdok->ok", col, filt)
+
+    exp1 = patch_row([(1, 1, 1, 0), (2, 1, 2, 1), (3, 2, 2, 1)])
+    exp2 = patch_row([(2, 1, 1, 0), (1, 1, 1, 1)])
+    np.testing.assert_allclose(o[0, 0], exp1, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(o[0, 1], exp2, rtol=1e-4, atol=1e-5)
