@@ -344,14 +344,16 @@ def _tree_patch_matrices(edges, max_nodes, max_depth):
     edges = np.asarray(edges)
     out = np.zeros((edges.shape[0], 3, max_nodes, max_nodes), np.float32)
     for b in range(edges.shape[0]):
+        # DIRECTED parent→child edges; a row with any zero endpoint
+        # terminates the list (reference construct_tree: `else break`)
         adj = {}
+        n_nodes = 1
         for u, v in edges[b]:
             u, v = int(u), int(v)
-            if u == 0 and v == 0:
-                continue
+            if u == 0 or v == 0:
+                break
             adj.setdefault(u, []).append(v)
-            adj.setdefault(v, []).append(u)
-        n_nodes = max((max(adj) if adj else 0), 0)
+            n_nodes += 1
         for root in range(1, n_nodes + 1):
             # iterative DFS matching the reference's stack traversal
             visited = {root}

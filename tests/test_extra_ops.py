@@ -243,7 +243,8 @@ def test_tree_conv_matches_manual(rng):
     o, = _run(out, {"nv": nodes, "ev": edges, "fv": filt})
     assert o.shape == (1, nmax, out_sz, k)
 
-    # manual: adjacency 1-{2,3}, 2-{1}, 3-{1}; depth<2 → root + children
+    # manual: DIRECTED tree 1→{2,3} (reference construct_tree); patches:
+    # root 1 = {1, 2, 3}; roots 2/3 have no children = {self}
     def eta(idx, pclen, depth, d=2.0):
         et = (d - depth) / d
         tmp = 0.5 if pclen == 1 else (idx - 1.0) / (pclen - 1.0)
@@ -260,6 +261,28 @@ def test_tree_conv_matches_manual(rng):
         return np.einsum("df,fdok->ok", col, filt)
 
     exp1 = patch_row([(1, 1, 1, 0), (2, 1, 2, 1), (3, 2, 2, 1)])
-    exp2 = patch_row([(2, 1, 1, 0), (1, 1, 1, 1)])
+    exp2 = patch_row([(2, 1, 1, 0)])
+    exp3 = patch_row([(3, 1, 1, 0)])
     np.testing.assert_allclose(o[0, 0], exp1, rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(o[0, 1], exp2, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(o[0, 2], exp3, rtol=1e-4, atol=1e-5)
+
+
+def test_tree_conv_half_zero_edge_terminates(rng):
+    """A row with one zero endpoint ends the edge list (reference
+    construct_tree breaks) — later edges and node 0 must not leak."""
+    f, out_sz, k, nmax = 3, 2, 2, 4
+    nodes = rng.randn(1, nmax, f).astype("float32")
+    filt = rng.randn(f, 3, out_sz, k).astype("float32")
+    # (0,3) terminates: the (1,2) edge after it is ignored too
+    edges_a = np.array([[[1, 2], [0, 3], [1, 4]]], "int32")
+    edges_b = np.array([[[1, 2], [0, 0], [0, 0]]], "int32")
+    nv = fluid.layers.data("nv", shape=[nmax, f])
+    ev = fluid.layers.data("ev", shape=[3, 2], dtype="int32")
+    fv = fluid.layers.data("fv", shape=[f, 3, out_sz, k], append_batch_size=False)
+    out = _op("tree_conv", {"NodesVector": nv, "EdgeSet": ev, "Filter": fv},
+              {"max_depth": 2})
+    oa, = _run(out, {"nv": nodes, "ev": edges_a, "fv": filt})
+    with fluid.scope_guard(fluid.Scope()):
+        ob, = _run(out, {"nv": nodes, "ev": edges_b, "fv": filt})
+    np.testing.assert_allclose(oa, ob, rtol=1e-6)
