@@ -38,7 +38,13 @@ def _on_tpu() -> bool:
 
 
 def _flash_ok(q, k, causal) -> bool:
-    """Shape gates for the Pallas kernel's blocking (seq multiples of 128)."""
+    """Gates for the Pallas kernel: blocking constraints (seq multiples of
+    128) AND a measured profitability threshold — on v5e the XLA-composed
+    attention is FASTER below ~8k sequence (loop-difference microbench,
+    benchmarks/bench_attention.py: S=2048 flash 5.2ms vs composed 3.3ms;
+    S=8192 flash 13.4ms vs composed 16.4ms). Flash's O(S) memory only pays
+    once the S² intermediate dominates. FLAGS_flash_attention_min_seq tunes
+    the crossover per hardware."""
     flash, _ = _flash_fn()
     if flash is None or not _on_tpu():
         return False
@@ -46,6 +52,10 @@ def _flash_ok(q, k, causal) -> bool:
     sk = k.shape[2]
     if causal and sq != sk:
         # the kernel's causal masking assumes square q/k lengths
+        return False
+    from ..flags import get_flag
+
+    if max(sq, sk) < int(get_flag("flash_attention_min_seq")):
         return False
     return sq % 128 == 0 and sk % 128 == 0 and q.dtype in (jnp.float32, jnp.bfloat16)
 

@@ -63,7 +63,17 @@ def _mk_qkv(rng, s=256, d=64):
     return q, q + 0.1, q + 0.2
 
 
-def test_flash_failure_warns_not_silent(rng, monkeypatch):
+@pytest.fixture
+def _flash_any_seq():
+    """Lower the profitability threshold so small test shapes take flash."""
+    from paddle_tpu.flags import set_flag
+
+    set_flag("flash_attention_min_seq", 128)
+    yield
+    set_flag("flash_attention_min_seq", 8192)
+
+
+def test_flash_failure_warns_not_silent(rng, monkeypatch, _flash_any_seq):
     """A failing Pallas flash call must emit a RuntimeWarning, not vanish."""
     q, k, v = _mk_qkv(rng)
 
@@ -77,7 +87,7 @@ def test_flash_failure_warns_not_silent(rng, monkeypatch):
     assert out.shape == q.shape
 
 
-def test_flash_failure_strict_mode_raises(rng, monkeypatch):
+def test_flash_failure_strict_mode_raises(rng, monkeypatch, _flash_any_seq):
     from paddle_tpu.flags import set_flag
 
     q, k, v = _mk_qkv(rng)
@@ -95,7 +105,7 @@ def test_flash_failure_strict_mode_raises(rng, monkeypatch):
         set_flag("strict_fused_attention", False)
 
 
-def test_flash_path_taken_when_gates_pass(rng, monkeypatch):
+def test_flash_path_taken_when_gates_pass(rng, monkeypatch, _flash_any_seq):
     """When on 'TPU' with clean shapes, sdpa must call the flash kernel."""
     q, k, v = _mk_qkv(rng)
     called = {}
@@ -110,9 +120,19 @@ def test_flash_path_taken_when_gates_pass(rng, monkeypatch):
     assert called.get("yes"), "flash path not taken despite passing gates"
 
 
-def test_flash_gate_rejects_causal_rectangular(rng, monkeypatch):
+def test_flash_gate_rejects_causal_rectangular(rng, monkeypatch, _flash_any_seq):
     monkeypatch.setattr(attention_ops, "_on_tpu", lambda: True)
     q = jnp.zeros((2, 4, 128, 64))
     k = jnp.zeros((2, 4, 256, 64))
     assert not attention_ops._flash_ok(q, k, causal=True)
     assert attention_ops._flash_ok(q, k, causal=False) or attention_ops._flash_fn()[0] is None
+
+
+def test_flash_gate_profitability_threshold(rng, monkeypatch):
+    """Below the measured crossover the composed path must win the gate."""
+    monkeypatch.setattr(attention_ops, "_on_tpu", lambda: True)
+    monkeypatch.setattr(attention_ops, "_flash_fn", lambda: (lambda *a, **k: None, None))
+    q = jnp.zeros((2, 4, 2048, 64))
+    assert not attention_ops._flash_ok(q, q, causal=False)
+    q8 = jnp.zeros((1, 4, 8192, 64))
+    assert attention_ops._flash_ok(q8, q8, causal=False)
