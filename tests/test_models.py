@@ -146,3 +146,51 @@ def test_deepfm_learns_and_auc_moves(rng):
             losses.append(float(l)); aucs.append(float(a))
     assert losses[-1] < losses[0], (losses[0], losses[-1])
     assert aucs[-1] > 0.55
+
+
+def test_vgg16_tiny_step(rng):
+    """VGG-16 config (reference benchmark/fluid/models/vgg.py) runs a train
+    step on a tiny input and the loss is finite and decreases."""
+    from paddle_tpu.models.vgg import vgg16
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 32, 32])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        loss, _ = vgg16(img, label, class_num=10)
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"img": rng.randn(4, 3, 32, 32).astype("float32"),
+            "label": rng.randint(0, 10, (4, 1)).astype("int64")}
+    l0 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+    for _ in range(4):
+        l1 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+    assert np.isfinite(l1) and l1 < l0, (l0, l1)
+
+
+def test_stacked_lstm_sentiment_learns(rng):
+    """stacked_dynamic_lstm config (reference benchmark model): learns a
+    token-presence sentiment rule."""
+    from paddle_tpu.models.stacked_lstm import stacked_lstm_net
+
+    vocab, t = 200, 12
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        words = fluid.layers.data("words", shape=[t], dtype="int64")
+        length = fluid.layers.data("length", shape=[], dtype="int64")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        loss, acc = stacked_lstm_net(words, length, label, dict_dim=vocab,
+                                     emb_dim=32, hid_dim=32, stacked_num=2)
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    n = 64
+    ys = rng.randint(0, 2, n)
+    ws = rng.randint(5, vocab, (n, t)).astype("int64")
+    ws[ys == 1, 0] = 3  # sentiment marker token
+    lens = rng.randint(6, t + 1, n).astype("int64")
+    feed = {"words": ws, "length": lens, "label": ys.reshape(-1, 1).astype("int64")}
+    losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+              for _ in range(12)]
+    assert losses[-1] < losses[0] * 0.7, losses
