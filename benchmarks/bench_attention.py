@@ -65,7 +65,8 @@ def main():
         set_flag("flash_attention_min_seq", 128)  # force flash for the A side
         tf = _per_iter_ms(lambda t, kk, vv: sdpa(t, kk, vv, causal=True,
                                                  sm_scale=d ** -0.5), q, k, v)
-        set_flag("flash_attention_min_seq", 8192)
+        set_flag("flash_attention_min_seq", 8192)  # restore the default
+        # B side calls the local composed() directly — no gate involved
         tc = _per_iter_ms(lambda t, kk, vv: composed(t, kk, vv, True), q, k, v)
         print(json.dumps({"bench": "attention_fwd_bwd_bf16_causal",
                           "b": b, "h": h, "s": s, "d": d,

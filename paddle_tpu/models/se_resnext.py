@@ -49,7 +49,9 @@ def se_resnext(img, label, class_num: int = 1000, layers_cfg=(3, 4, 6, 3),
                       pool_type="max")
     for block, n in enumerate(layers_cfg):
         for i in range(n):
-            x = _bottleneck(x, base_filters[block] // 2,
+            # reference passes num_filters=[128,256,512,1024] straight into
+            # the bottleneck (conv2 doubles it → stage outputs 256..2048)
+            x = _bottleneck(x, base_filters[block],
                             stride=2 if i == 0 and block > 0 else 1,
                             cardinality=cardinality)
     pool = layers.pool2d(x, pool_type="avg", global_pooling=True)

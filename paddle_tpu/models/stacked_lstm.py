@@ -1,6 +1,10 @@
-"""Stacked dynamic LSTM sentiment model (reference:
-benchmark/fluid/models/stacked_dynamic_lstm.py — embedding → N stacked
-fc+dynamic_lstm layers → max pools → fc softmax)."""
+"""Stacked dynamic LSTM sentiment model (reference: the book's
+stacked_lstm_net, python/paddle/fluid/tests/book/test_understand_sentiment.py
+— embedding → N stacked fc+dynamic_lstm layers with alternating direction →
+max pools → fc softmax. The similarly-named
+benchmark/fluid/models/stacked_dynamic_lstm.py is, despite its name, a
+single hand-rolled DynamicRNN LSTM with 'last' pooling — covered by the
+DynamicRNN tests)."""
 
 from __future__ import annotations
 
@@ -17,6 +21,9 @@ def stacked_lstm_net(words, length, label, dict_dim: int, emb_dim: int = 512,
 
     Padded+Length replaces the reference's LoD input; the lstm stack
     alternates direction per layer like the reference."""
+    if hid_dim % 4 != 0:
+        raise ValueError("hid_dim is the Fluid 4H projection size and must be "
+                         "divisible by 4, got %d" % hid_dim)
     emb = layers.embedding(words, size=[dict_dim, emb_dim])
     # Fluid contract: dynamic_lstm's ``size`` is 4·hidden and its input is
     # the 4H x-projection (same convention as the reference benchmark model)

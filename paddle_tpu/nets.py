@@ -99,6 +99,10 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
     from .layers.attention import multi_head_attention
 
     d_model = int(queries.shape[-1])
+    if d_model % num_heads != 0:
+        raise ValueError(
+            "hidden size %d is not divisible by num_heads %d (reference "
+            "nets.py raises here too)" % (d_model, num_heads))
     d_key = d_model // num_heads
     return multi_head_attention(
         queries, keys, values, attn_bias=None, d_key=d_key, d_value=d_key,

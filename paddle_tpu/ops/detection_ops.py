@@ -1101,8 +1101,8 @@ def generate_mask_labels_op(ctx: OpContext):
         cls_of = cls_b[best]                                 # [S]
         onehot = jax.nn.one_hot(cls_of, num_classes, dtype=jnp.int32)
         full = onehot[:, :, None, None] * masks[:, None, :, :]  # [S, C, R, R]
-        full = jnp.where(is_fg[:, None, None, None], full, 0)
-        # reference packs non-target entries as -1
+        # reference packs non-target entries as -1 (tgt_blk already excludes
+        # bg rois, so no separate is_fg zeroing is needed)
         tgt_blk = (onehot[:, :, None, None] == 1) & is_fg[:, None, None, None]
         packed = jnp.where(tgt_blk, full, -1)
         return packed.reshape(s, num_classes * r * r), is_fg.astype(jnp.int32)
@@ -1135,14 +1135,33 @@ def roi_perspective_transform_op(ctx: OpContext):
 
     def one(quad, bid):
         q = quad.reshape(4, 2) * scale  # tl, tr, br, bl
-        tl, tr, br, bl = q[0], q[1], q[2], q[3]
-        # bilinear warp of the quad (projective ≈ bilinear for mildly skewed
-        # text quads; the reference solves the full homography — for
-        # rectangles and parallelograms the two coincide)
-        top = tl[None, None] + (tr - tl)[None, None] * gx[..., None]
-        bot = bl[None, None] + (br - bl)[None, None] * gx[..., None]
-        pts = top + (bot - top) * gy[..., None]              # [oh, ow, 2]
-        px, py = pts[..., 0], pts[..., 1]
+        x0, y0 = q[0, 0], q[0, 1]
+        x1, y1 = q[1, 0], q[1, 1]
+        x2, y2 = q[2, 0], q[2, 1]
+        x3, y3 = q[3, 0], q[3, 1]
+        # full projective transform unit square → quad (the reference's
+        # get_transform_matrix, closed form): (u,v) ↦
+        # ((a·u + b·v + c) / w, (d·u + e·v + f) / w), w = g·u + h·v + 1
+        sx = x0 - x1 + x2 - x3
+        sy = y0 - y1 + y2 - y3
+        dx1 = x1 - x2
+        dx2 = x3 - x2
+        dy1 = y1 - y2
+        dy2 = y3 - y2
+        den = dx1 * dy2 - dy1 * dx2
+        den = jnp.where(jnp.abs(den) < 1e-12, 1e-12, den)
+        g = (sx * dy2 - sy * dx2) / den
+        h_ = (dx1 * sy - dy1 * sx) / den
+        a = x1 - x0 + g * x1
+        b_ = x3 - x0 + h_ * x3
+        c = x0
+        d_ = y1 - y0 + g * y1
+        e = y3 - y0 + h_ * y3
+        f = y0
+        wgt = g * gx + h_ * gy + 1.0
+        wgt = jnp.where(jnp.abs(wgt) < 1e-12, 1e-12, wgt)
+        px = (a * gx + b_ * gy + c) / wgt
+        py = (d_ * gx + e * gy + f) / wgt
         x0 = jnp.floor(px)
         y0 = jnp.floor(py)
         lx = px - x0

@@ -75,7 +75,7 @@ def switch_moe(x, gate_w, expert_params, expert_fn: Callable, mesh: Mesh,
 
     def shard_body(params, buf):
         # buf arrives [E/n_shards, C, D] for THIS shard's experts
-        return jax.vmap(expert_fn)(jax.tree.map(lambda p: p, params), buf)
+        return jax.vmap(expert_fn)(params, buf)
 
     expert_out = shard_map(
         shard_body, mesh=mesh,

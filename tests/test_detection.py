@@ -519,3 +519,54 @@ def test_roi_perspective_transform_identity_rect(rng):
                    + feat[0, :, y0 + 1, x0] * ly * (1 - lx)
                    + feat[0, :, y0 + 1, x0 + 1] * ly * lx)
             np.testing.assert_allclose(o[0, :, i, j], exp, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_perspective_transform_trapezoid_homography(rng):
+    """A genuinely perspective quad must follow the projective mapping
+    (independent 8x8 linear-system solve), not a bilinear corner blend."""
+    from paddle_tpu.layers.nn import LayerHelper
+
+    feat = rng.randn(1, 1, 16, 16).astype("float32")
+    # trapezoid: tl, tr, br, bl
+    quad = np.array([[2.0, 2.0, 12.0, 2.0, 10.0, 12.0, 4.0, 12.0]], "float32")
+    oh = ow = 4
+    x = fluid.layers.data("x", shape=[1, 16, 16])
+    q = fluid.layers.data("q", shape=[8])
+    helper = LayerHelper("rpt2")
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op("roi_perspective_transform",
+                     inputs={"X": x, "ROIs": q},
+                     outputs={"Out": out},
+                     attrs={"transformed_height": oh, "transformed_width": ow,
+                            "spatial_scale": 1.0})
+    o, = _run(out, {"x": feat, "q": quad})
+
+    # independent homography: solve for H mapping unit square -> quad
+    src = np.array([[0, 0], [1, 0], [1, 1], [0, 1]], float)
+    dst = quad[0].reshape(4, 2)
+    A, bvec = [], []
+    for (u, v), (X, Y) in zip(src, dst):
+        A.append([u, v, 1, 0, 0, 0, -u * X, -v * X])
+        bvec.append(X)
+        A.append([0, 0, 0, u, v, 1, -u * Y, -v * Y])
+        bvec.append(Y)
+    hpar = np.linalg.solve(np.array(A), np.array(bvec))
+    H = np.append(hpar, 1.0).reshape(3, 3)
+
+    def bilinear(im, yy, xx):
+        hgt, wid = im.shape
+        if not (0 <= yy < hgt - 1 and 0 <= xx < wid - 1):
+            yy = min(max(yy, 0), hgt - 1)
+            xx = min(max(xx, 0), wid - 1)
+        y0, x0 = int(np.floor(yy)), int(np.floor(xx))
+        y1i, x1i = min(y0 + 1, hgt - 1), min(x0 + 1, wid - 1)
+        ly, lx = yy - y0, xx - x0
+        return (im[y0, x0] * (1 - ly) * (1 - lx) + im[y0, x1i] * (1 - ly) * lx
+                + im[y1i, x0] * ly * (1 - lx) + im[y1i, x1i] * ly * lx)
+
+    for i in range(oh):
+        for j in range(ow):
+            u, v = (j + 0.5) / ow, (i + 0.5) / oh
+            X, Y, W = H @ np.array([u, v, 1.0])
+            exp = bilinear(feat[0, 0], Y / W, X / W)
+            np.testing.assert_allclose(o[0, 0, i, j], exp, rtol=1e-3, atol=1e-4)
