@@ -60,3 +60,48 @@ def record_event(name: str):
     shows up as a named range in the trace."""
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+class StepProfiler:
+    """Step-time statistics table (reference: profiler.py:221's sorted text
+    table — per-OP rows don't exist under XLA fusion, so the rows here are
+    named step scopes: wall time min/avg/max/total + calls, plus a pointer
+    at the full device trace for kernel-level drill-down).
+
+        prof = StepProfiler()
+        for batch in data:
+            with prof.step("train"):
+                exe.run(...)
+        print(prof.summary())
+    """
+
+    def __init__(self):
+        self._records = {}
+
+    @contextlib.contextmanager
+    def step(self, name: str = "step"):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._records.setdefault(name, []).append(time.perf_counter() - t0)
+
+    def reset(self):
+        self._records.clear()
+
+    def summary(self, sorted_key: str = "total") -> str:
+        keys = {"total": lambda r: -sum(r[1]), "max": lambda r: -max(r[1]),
+                "calls": lambda r: -len(r[1]), "ave": lambda r: -sum(r[1]) / len(r[1])}
+        rows = sorted(self._records.items(), key=keys.get(sorted_key, keys["total"]))
+        lines = ["%-24s %8s %12s %12s %12s %12s" % (
+            "Event", "Calls", "Total(ms)", "Min(ms)", "Max(ms)", "Ave(ms)")]
+        for name, ts in rows:
+            lines.append("%-24s %8d %12.3f %12.3f %12.3f %12.3f" % (
+                name, len(ts), sum(ts) * 1e3, min(ts) * 1e3, max(ts) * 1e3,
+                sum(ts) / len(ts) * 1e3))
+        lines.append("(kernel-level drill-down: run under profiler()/"
+                     "start_profiler and open the trace dir in TensorBoard)")
+        return "\n".join(lines)
+
+
+__all__ += ["StepProfiler"]

@@ -157,3 +157,21 @@ def test_nets_composites(rng):
     assert outs[3].shape == (2, 4)
     assert outs[4].shape == (2, 10, 8)
     assert all(np.isfinite(o).all() for o in outs)
+
+
+def test_step_profiler_table(rng):
+    import re
+    import time as _t
+
+    from paddle_tpu.profiler import StepProfiler
+
+    prof = StepProfiler()
+    for _ in range(3):
+        with prof.step("train"):
+            _t.sleep(0.002)
+    with prof.step("eval"):
+        _t.sleep(0.001)
+    table = prof.summary()
+    assert re.search(r"train\s+3\s+", table)
+    assert re.search(r"eval\s+1\s+", table)
+    assert "Ave(ms)" in table
