@@ -2,4 +2,4 @@
 (reference: benchmark/fluid/models/ — mnist, resnet, machine_translation;
 plus BERT and DeepFM from BASELINE.json's five workloads)."""
 
-from . import deepfm, mnist, resnet, se_resnext, stacked_lstm, transformer, vgg  # noqa: F401
+from . import deepfm, machine_translation, mnist, resnet, se_resnext, stacked_lstm, transformer, vgg  # noqa: F401

@@ -216,3 +216,32 @@ def test_se_resnext_tiny_step(rng):
     for _ in range(4):
         l1 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
     assert np.isfinite(l1) and l1 < l0, (l0, l1)
+
+
+def test_machine_translation_model_module(rng):
+    """The zoo's named seq_to_seq_net config trains to decreasing loss."""
+    from paddle_tpu.models.machine_translation import seq_to_seq_net
+
+    B, TS, TT, V = 6, 8, 7, 40
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 2
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data("src", shape=[TS], dtype="int64")
+        src_len = fluid.layers.data("src_len", shape=[], dtype="int64")
+        trg = fluid.layers.data("trg", shape=[TT], dtype="int64")
+        trg_len = fluid.layers.data("trg_len", shape=[], dtype="int64")
+        labels = fluid.layers.data("labels", shape=[TT, 1], dtype="int64")
+        loss, _ = seq_to_seq_net(src, src_len, trg, trg_len, labels, V,
+                                 embedding_dim=12, encoder_size=12,
+                                 decoder_size=12)
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"src": rng.randint(0, V, (B, TS)).astype("int64"),
+            "src_len": rng.randint(3, TS + 1, (B,)).astype("int64"),
+            "trg": rng.randint(0, V, (B, TT)).astype("int64"),
+            "trg_len": rng.randint(2, TT + 1, (B,)).astype("int64")}
+    feed["labels"] = np.roll(feed["trg"], -1, axis=1)[..., None].astype("int64")
+    losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+              for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
