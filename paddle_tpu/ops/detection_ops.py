@@ -1162,20 +1162,24 @@ def roi_perspective_transform_op(ctx: OpContext):
         wgt = jnp.where(jnp.abs(wgt) < 1e-12, 1e-12, wgt)
         px = (a * gx + b_ * gy + c) / wgt
         py = (d_ * gx + e * gy + f) / wgt
-        x0 = jnp.floor(px)
-        y0 = jnp.floor(py)
-        lx = px - x0
-        ly = py - y0
+        # distinct names from the homography coefficients/corners above —
+        # do not rename back to g/x0/y0 (shadowing trap)
+        ix0 = jnp.floor(px)
+        iy0 = jnp.floor(py)
+        lx = px - ix0
+        ly = py - iy0
 
-        def g(yy, xx):
+        def gather(yy, xx):
             inb = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
             yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
             xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
             v = x[bid][:, yc, xc]
             return jnp.where(inb[None], v, 0.0)
 
-        out = (g(y0, x0) * (1 - ly) * (1 - lx) + g(y0, x0 + 1) * (1 - ly) * lx
-               + g(y0 + 1, x0) * ly * (1 - lx) + g(y0 + 1, x0 + 1) * ly * lx)
+        out = (gather(iy0, ix0) * (1 - ly) * (1 - lx)
+               + gather(iy0, ix0 + 1) * (1 - ly) * lx
+               + gather(iy0 + 1, ix0) * ly * (1 - lx)
+               + gather(iy0 + 1, ix0 + 1) * ly * lx)
         return out
 
     ctx.set_output("Out", jax.vmap(one)(rois, batch_id.astype(jnp.int32)))

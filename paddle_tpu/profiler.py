@@ -91,8 +91,12 @@ class StepProfiler:
 
     def summary(self, sorted_key: str = "total") -> str:
         keys = {"total": lambda r: -sum(r[1]), "max": lambda r: -max(r[1]),
-                "calls": lambda r: -len(r[1]), "ave": lambda r: -sum(r[1]) / len(r[1])}
-        rows = sorted(self._records.items(), key=keys.get(sorted_key, keys["total"]))
+                "min": lambda r: -min(r[1]), "calls": lambda r: -len(r[1]),
+                "ave": lambda r: -sum(r[1]) / len(r[1])}
+        if sorted_key not in keys:
+            raise ValueError("sorted_key must be one of %s, got %r"
+                             % (sorted(keys), sorted_key))
+        rows = sorted(self._records.items(), key=keys[sorted_key])
         lines = ["%-24s %8s %12s %12s %12s %12s" % (
             "Event", "Calls", "Total(ms)", "Min(ms)", "Max(ms)", "Ave(ms)")]
         for name, ts in rows:
