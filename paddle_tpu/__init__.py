@@ -41,7 +41,7 @@ from . import (  # noqa: F401
 from .data_feeder import DataFeeder  # noqa: F401
 from .flags import flags, get_flag, set_flag  # noqa: F401
 from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
-from .backward import append_backward  # noqa: F401
+from .backward import append_backward, calc_gradient, gradients  # noqa: F401
 from .core.framework import (  # noqa: F401
     Program,
     Variable,

@@ -30,6 +30,17 @@ def _find_trainable_params(program: Program, parameter_list, no_grad_set) -> Lis
     return params
 
 
+def _unique_grad_name(block, base: str) -> str:
+    """A grad var name not yet taken in ``block`` (an earlier gradients()
+    call may already have claimed ``x@GRAD``; silently aliasing the two would
+    make one overwrite the other at execution)."""
+    name, k = base, 0
+    while block.has_var(name):
+        k += 1
+        name = "%s@RENAME@%d" % (base, k)
+    return name
+
+
 def append_backward(
     loss: Variable,
     parameter_list: Optional[Sequence] = None,
@@ -53,13 +64,14 @@ def append_backward(
     param_to_grad: Dict[str, str] = {}
     param_grads: List[Tuple[Parameter, Variable]] = []
     for p in params:
-        gname = grad_var_name(p.name)
+        gname = _unique_grad_name(block, grad_var_name(p.name))
         gvar = block.create_var(name=gname, shape=p.shape, dtype=p.dtype, stop_gradient=True)
         param_to_grad[p.name] = gname
         param_grads.append((p, gvar))
 
+    loss_grad_name = _unique_grad_name(block, grad_var_name(loss.name))
     loss_grad = block.create_var(
-        name=grad_var_name(loss.name), shape=loss.shape, dtype=loss.dtype, stop_gradient=True
+        name=loss_grad_name, shape=loss.shape, dtype=loss.dtype, stop_gradient=True
     )
     block.append_op(
         "backward_marker",
@@ -67,17 +79,88 @@ def append_backward(
         outputs={"ParamGrads": [g for _, g in param_grads]},
         attrs={"loss": loss.name, "param_to_grad": dict(param_to_grad)},
     )
-    program._backward_info = {"loss": loss.name, "param_to_grad": param_to_grad}
+    program._backward_info = {
+        "loss": loss.name,
+        "param_to_grad": param_to_grad,
+        "loss_grad": loss_grad_name,
+    }
     return param_grads
 
 
-def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
-    """jax.grad-backed replacement for fluid.gradients (backward.py:613)."""
-    raise NotImplementedError(
-        "gradients() for arbitrary targets is provided via Executor fetch of "
-        "@GRAD vars after append_backward; arbitrary-var grads land with the "
-        "inference/export milestone."
+def _as_var_list(x) -> List[Variable]:
+    if isinstance(x, Variable):
+        return [x]
+    return list(x)
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None) -> List[Variable]:
+    """Compute d(targets)/d(inputs) (reference: backward.py:613 calc_gradient).
+
+    Appends a ``calc_gradient`` op (ops/gradient_ops.py) that jax.vjp's the
+    traced forward prefix at execution time. Returns one grad Variable per
+    input; fetch them (or feed them onward) like any other var. May be called
+    multiple times per program — each call differentiates the ops appended so
+    far, so GAN-style per-loss gradients and double-grad (a later call whose
+    prefix contains an earlier marker) both work.
+
+    ``target_gradients`` seeds the vjp per target (default: ones, like the
+    reference). ``no_grad_set`` names are treated as stop_gradient.
+    Inputs with no path to any target yield zeros (the reference returns
+    None; a traced program cannot know reachability per-element at trace
+    time, so zeros are the functional equivalent).
+    """
+    targets = _as_var_list(targets)
+    inputs = _as_var_list(inputs)
+    if not targets or not inputs:
+        raise ValueError("gradients() needs at least one target and one input")
+    program = targets[0].block.program
+    block = program.global_block
+
+    if target_gradients is None:
+        tg_list: List[Optional[Variable]] = [None] * len(targets)
+    else:
+        tg_list = _as_var_list(target_gradients)
+        if len(tg_list) != len(targets):
+            raise ValueError(
+                "target_gradients must match targets: got %d vs %d"
+                % (len(tg_list), len(targets)))
+    no_grad_names = sorted(
+        v.name if isinstance(v, Variable) else str(v) for v in (no_grad_set or ()))
+
+    # Dedup repeated inputs: the env is keyed by name, so each name is one
+    # leaf; duplicates share the grad var (the reference returns the same
+    # gradient for each occurrence too).
+    grad_by_name: Dict[str, Variable] = {}
+    unique_inputs: List[Variable] = []
+    for v in inputs:
+        if v.name in grad_by_name:
+            continue
+        if v.dtype is not None and not str(v.dtype).startswith(("float", "bfloat")):
+            raise TypeError(
+                "gradients() input %r has non-differentiable dtype %s"
+                % (v.name, v.dtype))
+        gname = _unique_grad_name(block, grad_var_name(v.name))
+        grad_by_name[v.name] = block.create_var(
+            name=gname, shape=v.shape, dtype=v.dtype, stop_gradient=True)
+        unique_inputs.append(v)
+    grad_vars = [grad_by_name[v.name] for v in unique_inputs]
+
+    op_inputs = {"Targets": targets, "Inputs": unique_inputs}
+    tg_vars = [g for g in tg_list if g is not None]
+    if tg_vars:
+        op_inputs["TargetGradients"] = tg_vars
+    block.append_op(
+        "calc_gradient",
+        inputs=op_inputs,
+        outputs={"InputGrads": grad_vars},
+        attrs={
+            "targets": [t.name for t in targets],
+            "inputs": [v.name for v in unique_inputs],
+            "target_gradients": [g.name if g is not None else None for g in tg_list],
+            "no_grad_set": no_grad_names,
+        },
     )
+    return [grad_by_name[v.name] for v in inputs]
 
 
 calc_gradient = gradients

@@ -272,7 +272,9 @@ class Block:
                 if name in self.vars:
                     self.vars[name].op = op
         self.program._version += 1
-        if type != "backward_marker":
+        # calc_gradient sets its output shapes itself; abstractly executing it
+        # would eval_shape-retrace the whole forward prefix per call.
+        if type not in ("backward_marker", "calc_gradient"):
             from .shape_inference import infer_op_shapes
 
             infer_op_shapes(op, self)

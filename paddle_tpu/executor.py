@@ -244,7 +244,8 @@ class _CompiledStep:
 
                     env[param_to_grad[p]] = SparseGrad(
                         env["__sparse_ids__" + p], vgrads[p])
-                env[grad_var_name(loss_name)] = jnp.ones_like(jnp.sum(env[loss_name]))
+                env[bw.get("loss_grad") or grad_var_name(loss_name)] = jnp.ones_like(
+                    jnp.sum(env[loss_name]))
                 run_block_ops(post_ops, env, trace, offset=marker_idx + 1)
 
             new_state = {}

@@ -8,6 +8,7 @@ from . import (  # noqa: F401
     control_flow_ops,
     detection_ops,
     extra_ops,
+    gradient_ops,
     loss_ops,
     math_ops,
     metric_ops,

@@ -1,0 +1,115 @@
+"""calc_gradient op: d(targets)/d(inputs) inside the traced step.
+
+Reference: ``python/paddle/fluid/backward.py:613`` (``calc_gradient``) — Fluid
+walks the forward ops in reverse appending grad ops between targets and
+inputs. The TPU-native design instead re-interprets the op prefix that leads
+up to the marker as a pure function of the requested inputs and applies
+``jax.vjp`` to it at trace time, so the backward is XLA-fused like everything
+else. Because the marker is an ordinary op, ``fluid.gradients`` may be called
+several times in one program (GAN two-loss style), and a later marker whose
+prefix contains an earlier one differentiates *through* it — the double-grad
+idiom — via JAX's nested AD.
+
+Semantics notes (vs the reference):
+- each requested input is treated as an independent leaf: the graph is cut at
+  that variable, so gradients do not flow through it to upstream producers
+  (matching Fluid, which seeds ``input@GRAD`` directly);
+- inputs with no path to any target get zero gradients (Fluid returns None
+  for them; a traced program cannot distinguish structurally-zero at trace
+  time, so zeros are the faithful equivalent);
+- ``no_grad_set`` variables are wrapped in ``stop_gradient`` as soon as they
+  are produced.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.interpreter import SKIP_OPS
+from ..core.registry import OpContext, get_op_impl, register_op
+
+
+@register_op("calc_gradient")
+def _calc_gradient(ctx: OpContext):
+    op = ctx.op
+    block = op.block
+    my_idx = next(i for i, o in enumerate(block.ops) if o is op)
+    prefix = block.ops[:my_idx]
+    target_names = list(op.attrs["targets"])
+    input_names = list(op.attrs["inputs"])
+    tg_names = list(op.attrs.get("target_gradients") or [None] * len(target_names))
+    no_grad = frozenset(op.attrs.get("no_grad_set") or ())
+
+    # Backward slice: keep only the ops that transitively produce the targets,
+    # cutting at the requested inputs. A reverse walk keeps the LAST producer
+    # of each needed name (program-order semantics: the op reads the latest
+    # value), and naturally excludes training-tail ops — backward_marker,
+    # optimizer/clip updates — so gradients() works on a program that already
+    # called minimize()/append_backward.
+    input_set = set(input_names)
+    needed = set(target_names) - input_set
+    sliced = []  # (original prefix index, op), reverse order
+    for i in range(len(prefix) - 1, -1, -1):
+        o = prefix[i]
+        if o.type in SKIP_OPS:
+            continue
+        outs = {n for ns in o.outputs.values() for n in ns}
+        if outs & needed:
+            sliced.append((i, o))
+            needed -= outs
+            for ns in o.inputs.values():
+                needed.update(ns)
+            needed -= input_set  # leaves: don't pull their producers
+    sliced.reverse()
+
+    # Per-op re-assert lists: a leaf/no_grad wrap is only needed when the op
+    # actually (re)wrote that name — unconditional re-wrapping would grow the
+    # jaxpr O(ops × vars) in identity equations.
+    slice_plan = []
+    for i, o in sliced:
+        outs = {n for ns in o.outputs.values() for n in ns}
+        leaf_hits = [j for j, n in enumerate(input_names) if n in outs]
+        ng_hits = [n for n in no_grad if n in outs]
+        slice_plan.append((i, o, leaf_hits, ng_hits))
+
+    written = {n for _, o in sliced for ns in o.outputs.values() for n in ns}
+    # Base env: everything the slice does NOT recompute (feeds, params —
+    # including values training-tail ops already rewrote — startup state).
+    # The slice re-runs from here inside the vjp'd function; XLA CSE merges
+    # the recomputation with the original forward at compile time.
+    base = {k: v for k, v in ctx.env.items() if k not in written}
+    leaves = [ctx._lookup(n) for n in input_names]
+    trace = ctx.trace
+
+    def fwd(leaf_vals):
+        env = dict(base)
+        env.update(zip(input_names, leaf_vals))
+        from ..core.enforce import EnforceNotMet, wrap_op_error
+
+        for i, o, leaf_hits, ng_hits in slice_plan:
+            trace.current_op_idx = i
+            try:
+                get_op_impl(o.type)(OpContext(o, env, trace))
+            except (EnforceNotMet, NotImplementedError):
+                raise
+            except Exception as e:
+                raise wrap_op_error(e, o, i, env) from e
+            # Re-assert leaves: if this op (re)produced a requested input, the
+            # leaf value wins — that is what cuts the graph at the input.
+            for j in leaf_hits:
+                env[input_names[j]] = leaf_vals[j]
+            for n in ng_hits:
+                env[n] = jax.lax.stop_gradient(env[n])
+        return [env[t] for t in target_names]
+
+    targets_out, vjp_fn = jax.vjp(fwd, leaves)
+    seeds = []
+    for t_out, tg in zip(targets_out, tg_names):
+        if tg:
+            seeds.append(ctx._lookup(tg).astype(t_out.dtype))
+        else:
+            seeds.append(jnp.ones_like(t_out))
+    (grads,) = vjp_fn(seeds)
+    trace.current_op_idx = my_idx
+    ctx.set_outputs("InputGrads", grads)

@@ -1,0 +1,181 @@
+"""fluid.gradients / calc_gradient (reference: backward.py:613).
+
+Covers the VERDICT round-2 gap: arbitrary targets/inputs, target_gradients
+seeding, no_grad_set, multiple calls per program (GAN two-loss), and the
+double-grad idiom (gradients of gradients).
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _run(main, startup, feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_gradients_wrt_feed_var(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.reduce_sum(fluid.layers.square(x))
+        (gx,) = fluid.gradients(y, x)
+    xs = rng.randn(3, 4).astype("float32")
+    (g,) = _run(main, startup, {"x": xs}, [gx])
+    np.testing.assert_allclose(g, 2 * xs, rtol=1e-5)
+
+
+def test_gradients_of_intermediate_cuts_graph(rng):
+    # d y / d h treats h as an independent leaf: dy/dh = 2h, regardless of
+    # h's own producer (h = 3x).
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        h = fluid.layers.scale(x, scale=3.0)
+        y = fluid.layers.reduce_sum(fluid.layers.square(h))
+        (gh,) = fluid.gradients(y, h)
+    xs = rng.randn(2, 4).astype("float32")
+    (g,) = _run(main, startup, {"x": xs}, [gh])
+    np.testing.assert_allclose(g, 2 * 3.0 * xs, rtol=1e-5)
+
+
+def test_gradients_wrt_parameter(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        out = fluid.layers.fc(x, size=2, bias_attr=False)
+        loss = fluid.layers.reduce_sum(out)
+        w = main.all_parameters()[0]
+        (gw,) = fluid.gradients(loss, w)
+    xs = rng.randn(5, 4).astype("float32")
+    (g,) = _run(main, startup, {"x": xs}, [gw])
+    # d sum(x @ W) / d W = sum_rows(x) broadcast over output cols
+    expect = np.tile(xs.sum(0, keepdims=True).T, (1, 2))
+    np.testing.assert_allclose(g, expect, rtol=1e-4)
+
+
+def test_target_gradients_seed(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        seed = fluid.layers.data("seed", shape=[4])
+        y = fluid.layers.square(x)  # elementwise target, same shape as seed
+        (gx,) = fluid.gradients(y, x, target_gradients=seed)
+    xs = rng.randn(2, 4).astype("float32")
+    ss = rng.randn(2, 4).astype("float32")
+    (g,) = _run(main, startup, {"x": xs, "seed": ss}, [gx])
+    np.testing.assert_allclose(g, 2 * xs * ss, rtol=1e-5)
+
+
+def test_no_grad_set_blocks_flow(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        a = fluid.layers.scale(x, scale=2.0)  # path 1 (blocked)
+        b = fluid.layers.scale(x, scale=5.0)  # path 2
+        y = fluid.layers.reduce_sum(a + b)
+        (gx,) = fluid.gradients(y, x, no_grad_set={a.name})
+    xs = rng.randn(2, 4).astype("float32")
+    (g,) = _run(main, startup, {"x": xs}, [gx])
+    np.testing.assert_allclose(g, np.full_like(xs, 5.0), rtol=1e-5)
+
+
+def test_two_losses_gan_style(rng):
+    # Two independent gradients() calls on one program — per-loss grads of a
+    # shared input, as a GAN script computes d/g losses wrt shared fakes.
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        loss_a = fluid.layers.reduce_sum(fluid.layers.square(x))
+        (ga,) = fluid.gradients(loss_a, x)
+        loss_b = fluid.layers.reduce_sum(fluid.layers.scale(x, scale=7.0))
+        (gb,) = fluid.gradients(loss_b, x)
+        assert ga.name != gb.name  # second call must not collide on x@GRAD
+    xs = rng.randn(3, 4).astype("float32")
+    a, b = _run(main, startup, {"x": xs}, [ga, gb])
+    np.testing.assert_allclose(a, 2 * xs, rtol=1e-5)
+    np.testing.assert_allclose(b, np.full_like(xs, 7.0), rtol=1e-5)
+
+
+def test_double_grad(rng):
+    # y = sum(x^3); g = dy/dx = 3x^2; z = sum(g^2) = sum(9 x^4);
+    # dz/dx = 36 x^3 — the WGAN-GP gradient-penalty idiom.
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.reduce_sum(fluid.layers.pow(x, factor=3.0))
+        (g1,) = fluid.gradients(y, x)
+        z = fluid.layers.reduce_sum(fluid.layers.square(g1))
+        (g2,) = fluid.gradients(z, x)
+    xs = np.abs(rng.randn(2, 4)).astype("float32") + 0.5
+    (g,) = _run(main, startup, {"x": xs}, [g2])
+    np.testing.assert_allclose(g, 36 * xs**3, rtol=1e-4)
+
+
+def test_gradients_after_minimize(rng):
+    # gradients() on a program that already built its training tail: the
+    # backward slice must skip backward_marker + optimizer ops (round-3
+    # review finding — this used to KeyError on the optimizer-rewritten
+    # param names).
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        out = fluid.layers.fc(x, size=1, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square(out))
+        fluid.optimizer.SGD(learning_rate=0.0).minimize(loss)
+        (gx,) = fluid.gradients(loss, x)
+    xs = rng.randn(6, 4).astype("float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    w = np.asarray(fluid.global_scope().find_var(main.all_parameters()[0].name))
+    (g,) = exe.run(main, feed={"x": xs}, fetch_list=[gx])
+    expect = 2.0 / len(xs) * (xs @ w) @ w.T
+    np.testing.assert_allclose(g, expect, rtol=1e-4)
+
+
+def test_gradients_then_minimize_no_alias(rng):
+    # gradients() claims W@GRAD first; append_backward must rename its own
+    # grad var instead of silently overwriting the fetched one.
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        out = fluid.layers.fc(x, size=1, bias_attr=False)
+        w = main.all_parameters()[0]
+        aux = fluid.layers.reduce_sum(out)          # d aux / d W = sum_rows(x)
+        (gw_aux,) = fluid.gradients(aux, w)
+        loss = fluid.layers.mean(fluid.layers.square(out))
+        fluid.optimizer.SGD(learning_rate=0.0).minimize(loss)
+    xs = rng.randn(6, 4).astype("float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (g,) = exe.run(main, feed={"x": xs}, fetch_list=[gw_aux])
+    np.testing.assert_allclose(g, xs.sum(0, keepdims=True).T, rtol=1e-4)
+
+
+def test_gradients_duplicate_inputs(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.reduce_sum(fluid.layers.square(x))
+        g1, g2 = fluid.gradients(y, [x, x])
+        assert g1 is g2  # duplicates share one leaf/grad
+    xs = rng.randn(2, 4).astype("float32")
+    (g,) = _run(main, startup, {"x": xs}, [g1])
+    np.testing.assert_allclose(g, 2 * xs, rtol=1e-5)
+
+
+def test_gradients_int_input_rejected():
+    import pytest
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        idx = fluid.layers.data("idx", shape=[1], dtype="int64")
+        y = fluid.layers.reduce_sum(fluid.layers.cast(idx, "float32"))
+        with pytest.raises(TypeError, match="non-differentiable"):
+            fluid.gradients(y, idx)
+
+
+def test_calc_gradient_alias():
+    assert fluid.backward.calc_gradient is fluid.backward.gradients
