@@ -44,10 +44,16 @@ def convert_dtype(dtype) -> str:
 
 
 def to_jnp_dtype(dtype):
+    """Canonicalized for the active JAX config: with x64 disabled (the
+    default — TPU-native int32/float32 widths), a declared int64/float64
+    maps to int32/float32 HERE, once, instead of every downstream
+    astype/arange warning about silent truncation."""
     name = convert_dtype(dtype)
     if name == "bfloat16":
         return jnp.bfloat16
-    return np.dtype(name)
+    import jax
+
+    return np.dtype(jax.dtypes.canonicalize_dtype(np.dtype(name)))
 
 
 def is_float_dtype(dtype) -> bool:

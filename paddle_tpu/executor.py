@@ -363,9 +363,18 @@ class Executor:
         # reader whose vars aren't explicitly fed (reference: the in-graph
         # `read` op popping the blocking queue; raises EOFException at end).
         for reader in getattr(program, "_py_readers", ()):
-            if reader._started and not all(n in feed for n in reader.var_names):
+            if not reader._started:
+                continue
+            fed = [n for n in reader.var_names if n in feed]
+            if not fed:
                 for n, v in reader.next_feed().items():
-                    feed.setdefault(n, v)  # explicit feed wins over the queue
+                    feed[n] = v
+            elif len(fed) != len(reader.var_names):
+                # Mixing an explicit partial feed with queue data would
+                # silently consume a queued batch and pair unrelated rows.
+                raise ValueError(
+                    "run(): feed covers only %s of started py_reader vars %s; "
+                    "feed all of them or none" % (fed, list(reader.var_names)))
         fetch_names = self._fetch_names(fetch_list)
 
         block = program.global_block

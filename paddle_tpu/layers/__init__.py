@@ -51,6 +51,7 @@ from .sequence import *  # noqa: F401,F403
 from .io import create_py_reader_by_data, data, double_buffer, py_reader, read_file  # noqa: F401
 from .layer_helper import LayerHelper, ParamAttr  # noqa: F401
 from .nn import *  # noqa: F401,F403
+from .layer_function_generator import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .tensor import (  # noqa: F401
     assign,

@@ -1461,3 +1461,248 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
 
 __all__ += ["edit_distance", "chunk_eval", "grid_sampler", "spp", "unpool",
             "max_pool2d_with_index", "psroi_pool", "Print", "py_func"]
+
+
+# -- round-3 layer-surface parity sweep (VERDICT item 4) ----------------------
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    """Adaptive pooling to a fixed output size (reference: nn.py
+    adaptive_pool2d → pool2d op with adaptive=True)."""
+    if require_index:
+        raise NotImplementedError(
+            "adaptive_pool2d(require_index=True): argmax-index output is not "
+            "implemented; use max_pool2d_with_index for indices")
+    helper = LayerHelper("pool2d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "pool2d", inputs={"X": input}, outputs={"Out": out},
+        attrs={"pooling_type": pool_type, "ksize": list(_pair(pool_size)),
+               "adaptive": True})
+    return out
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    """3-D adaptive pooling (reference: nn.py adaptive_pool3d)."""
+    if require_index:
+        raise NotImplementedError(
+            "adaptive_pool3d(require_index=True): argmax-index output is not "
+            "implemented; use max_pool2d_with_index for indices")
+    helper = LayerHelper("pool3d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "pool3d", inputs={"X": input}, outputs={"Out": out},
+        attrs={"pooling_type": pool_type, "ksize": list(_triple(pool_size)),
+               "adaptive": True})
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    """3-D transposed convolution (reference: nn.py conv3d_transpose)."""
+    helper = LayerHelper("conv3d_transpose", bias_attr=bias_attr, act=act,
+                         name=name)
+    num_channels = input.shape[1]
+    stride = _triple(stride)
+    padding = _triple(padding)
+    dilation = _triple(dilation)
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("filter_size or output_size required")
+        output_size = _triple(output_size)
+        filter_size = [
+            (output_size[i] - (input.shape[2 + i] - 1) * stride[i]
+             + 2 * padding[i] - 1) // dilation[i] + 1
+            for i in range(3)
+        ]
+    else:
+        filter_size = list(_triple(filter_size))
+    filter_shape = [num_channels, num_filters // (groups or 1)] + filter_size
+    w = helper.create_parameter(param_attr, shape=filter_shape,
+                                dtype=input.dtype)
+    pre_bias = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "conv3d_transpose",
+        inputs={"Input": input, "Filter": w},
+        outputs={"Output": pre_bias},
+        attrs={"strides": list(stride), "paddings": list(padding),
+               "dilations": list(dilation), "groups": groups or 1},
+    )
+    if bias_attr is False:
+        pre_act = pre_bias
+    else:
+        bias = helper.create_parameter(ParamAttr.to_attr(bias_attr),
+                                       shape=[num_filters],
+                                       dtype=input.dtype, is_bias=True)
+        pre_act = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("elementwise_add", inputs={"X": pre_bias, "Y": bias},
+                         outputs={"Out": pre_act}, attrs={"axis": 1})
+    return helper.append_activation(pre_act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectral weight normalization (reference: nn.py spectral_norm,
+    operators/spectral_norm_op.cc). The power-iteration vectors U/V live as
+    persistent non-trainable parameters; the op writes their updated values
+    back (UOut/VOut wired onto the same vars), so the iteration state
+    advances across steps like the reference's in-place buffers."""
+    from .. import initializer as init_mod
+
+    helper = LayerHelper("spectral_norm", name=name)
+    h = weight.shape[dim]
+    w = 1
+    for i, s in enumerate(weight.shape):
+        if i != dim:
+            w *= s
+    u = helper.create_parameter(
+        ParamAttr(trainable=False, initializer=init_mod.Normal(0.0, 1.0)),
+        shape=[h], dtype=weight.dtype)
+    v = helper.create_parameter(
+        ParamAttr(trainable=False, initializer=init_mod.Normal(0.0, 1.0)),
+        shape=[w], dtype=weight.dtype)
+    out = helper.create_variable_for_type_inference(weight.dtype)
+    helper.append_op(
+        "spectral_norm",
+        inputs={"Weight": weight, "U": u, "V": v},
+        outputs={"Out": out, "UOut": u, "VOut": v},
+        attrs={"dim": dim, "power_iters": power_iters, "eps": eps},
+    )
+    return out
+
+
+def dice_loss(input, label, epsilon=0.00001):
+    """Dice loss for segmentation (reference: nn.py dice_loss — a pure
+    layer composition, mirrored here)."""
+    label = one_hot(label, depth=input.shape[-1])
+    reduce_dim = list(range(1, len(input.shape)))
+    inse = reduce_sum(elementwise_mul(input, label), dim=reduce_dim)
+    denom = reduce_sum(input, dim=reduce_dim) + reduce_sum(label, dim=reduce_dim)
+    dice_score = 1 - inse * 2 / (denom + epsilon)
+    return reduce_mean(dice_score)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize so the short image edge equals ``out_short_len`` (reference:
+    nn.py image_resize_short — static-shape composition over image_resize)."""
+    in_shape = input.shape
+    if len(in_shape) != 4:
+        raise ValueError(
+            "The rank of input must be 4 (num_batches, channels, in_h, in_w).")
+    hw = list(in_shape[2:4])
+    short_idx = hw.index(min(hw))
+    long_idx = 1 - short_idx
+    out_shape = list(hw)
+    out_shape[short_idx] = out_short_len
+    out_shape[long_idx] = int(
+        float(out_shape[long_idx]) * (float(out_short_len) / float(hw[short_idx]))
+        + 0.5)
+    return image_resize(input=input, out_shape=out_shape, resample=resample)
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples, num_true=1,
+                                       remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None, seed=0):
+    """Sampled-softmax cross entropy (reference: nn.py
+    sampled_softmax_with_cross_entropy — composes the sample_logits op with
+    soft-label softmax_with_cross_entropy)."""
+    if num_true != 1:
+        raise NotImplementedError(
+            "sampled_softmax_with_cross_entropy: num_true>1 (the reference's "
+            "one_hot(depth=num_samples+1) construction is only consistent "
+            "for a single true label)")
+    helper = LayerHelper("sample_logits")
+    samples = helper.create_variable_for_type_inference("int64",
+                                                        stop_gradient=True)
+    probabilities = helper.create_variable_for_type_inference(logits.dtype,
+                                                              stop_gradient=True)
+    sampled_logits = helper.create_variable_for_type_inference(logits.dtype)
+    sampled_label = helper.create_variable_for_type_inference("int64",
+                                                              stop_gradient=True)
+    inputs = {"Logits": logits, "Labels": label}
+    if customized_samples is not None:
+        inputs["CustomizedSamples"] = customized_samples
+    if customized_probabilities is not None:
+        inputs["CustomizedProbabilities"] = customized_probabilities
+    helper.append_op(
+        "sample_logits",
+        inputs=inputs,
+        outputs={"Samples": samples, "Probabilities": probabilities,
+                 "SampledLogits": sampled_logits,
+                 "SampledLabels": sampled_label},
+        attrs={"use_customized_samples": use_customized_samples, "uniq": True,
+               "remove_accidental_hits": remove_accidental_hits,
+               "num_samples": num_samples, "seed": seed},
+    )
+    soft = one_hot(sampled_label, depth=num_true + num_samples)
+    loss = softmax_with_cross_entropy(sampled_logits, soft, soft_label=True)
+    return loss / num_true
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """Row-wise integer hashing into [0, hash_size) (reference: nn.py hash,
+    operators/hash_op.cc)."""
+    helper = LayerHelper("hash", name=name)
+    out = helper.create_variable_for_type_inference("int64", stop_gradient=True)
+    helper.append_op("hash", inputs={"X": input}, outputs={"Out": out},
+                     attrs={"num_hash": num_hash, "mod_by": hash_size})
+    return out
+
+
+def merge_selected_rows(x, name=None):
+    """Sum duplicate rows of a SelectedRows value (reference: nn.py
+    merge_selected_rows)."""
+    helper = LayerHelper("merge_selected_rows", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("merge_selected_rows", inputs={"X": x},
+                     outputs={"Out": out})
+    return out
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """Densify a SelectedRows' value block (reference: nn.py
+    get_tensor_from_selected_rows)."""
+    helper = LayerHelper("get_tensor_from_selected_rows", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("get_tensor_from_selected_rows", inputs={"X": x},
+                     outputs={"Out": out})
+    return out
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1, max_depth=2,
+              act="tanh", param_attr=None, bias_attr=None, name=None):
+    """Tree-based convolution (reference: nn.py tree_conv, TBCNN)."""
+    helper = LayerHelper("tree_conv", bias_attr=bias_attr, act=act, name=name)
+    feature_size = nodes_vector.shape[2]
+    w = helper.create_parameter(
+        param_attr, shape=[feature_size, 3, output_size, num_filters],
+        dtype=nodes_vector.dtype)
+    pre_bias = helper.create_variable_for_type_inference(nodes_vector.dtype)
+    helper.append_op(
+        "tree_conv",
+        inputs={"NodesVector": nodes_vector, "EdgeSet": edge_set, "Filter": w},
+        outputs={"Out": pre_bias},
+        attrs={"max_depth": max_depth},
+    )
+    if bias_attr is False:
+        pre_act = pre_bias
+    else:
+        bias = helper.create_parameter(
+            ParamAttr.to_attr(bias_attr), shape=[num_filters],
+            dtype=nodes_vector.dtype, is_bias=True)
+        pre_act = helper.create_variable_for_type_inference(nodes_vector.dtype)
+        helper.append_op("elementwise_add", inputs={"X": pre_bias, "Y": bias},
+                         outputs={"Out": pre_act}, attrs={"axis": -1})
+    return helper.append_activation(pre_act)
+
+
+__all__ += ["adaptive_pool2d", "adaptive_pool3d", "conv3d_transpose",
+            "spectral_norm", "dice_loss", "image_resize_short",
+            "sampled_softmax_with_cross_entropy", "hash",
+            "merge_selected_rows", "get_tensor_from_selected_rows",
+            "tree_conv"]

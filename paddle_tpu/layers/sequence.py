@@ -28,6 +28,8 @@ __all__ = [
     "sequence_last_step",
     "im2sequence",
     "row_conv",
+    "sequence_conv",
+    "sequence_reshape",
 ]
 
 
@@ -145,3 +147,51 @@ def row_conv(input, future_context_size, param_attr=None, act=None, name=None):
     out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op("row_conv", inputs={"X": input, "Filter": w}, outputs={"Out": out})
     return helper.append_activation(out) if act else out
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None,
+                  length=None, name=None):
+    """Convolution over the time axis with a context window (reference:
+    nn.py sequence_conv, operators/sequence_conv_op.cc). ``length`` is the
+    padded+Length convention's per-row length var."""
+    if filter_stride != 1:
+        raise NotImplementedError("sequence_conv: only filter_stride=1 "
+                                  "(matching the reference kernel)")
+    helper = LayerHelper("sequence_conv", bias_attr=bias_attr, act=act,
+                         name=name)
+    filter_shape = [filter_size * input.shape[2], num_filters]
+    w = helper.create_parameter(param_attr, shape=filter_shape,
+                                dtype=input.dtype)
+    pre_bias = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": input, "Filter": w}
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op(
+        "sequence_conv", inputs=inputs, outputs={"Out": pre_bias},
+        attrs={"contextLength": filter_size,
+               "contextStart": -(filter_size // 2), "contextStride": 1})
+    if bias_attr is False:
+        pre_act = pre_bias
+    else:
+        from .layer_helper import ParamAttr
+
+        bias = helper.create_parameter(ParamAttr.to_attr(bias_attr),
+                                       shape=[num_filters],
+                                       dtype=input.dtype, is_bias=True)
+        pre_act = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("elementwise_add", inputs={"X": pre_bias, "Y": bias},
+                         outputs={"Out": pre_act}, attrs={"axis": -1})
+    return helper.append_activation(pre_act)
+
+
+def sequence_reshape(input, new_dim, length=None):
+    """Re-chunk the feature dim: [B, T, D] -> [B, T*D/new_dim, new_dim]
+    (reference: nn.py sequence_reshape). Returns (out, new_length) when
+    ``length`` is given, else out."""
+    inputs = {"X": input}
+    if length is not None:
+        inputs["Length"] = length
+        return _seq_op("sequence_reshape", inputs, {"new_dim": new_dim},
+                       extra_outs=("OutLength",))
+    return _seq_op("sequence_reshape", inputs, {"new_dim": new_dim})

@@ -64,7 +64,7 @@ def read_from_array_op(ctx: OpContext):
 @register_op("lod_array_length")
 def lod_array_length_op(ctx: OpContext):
     _buf, count = ctx.input("Array")
-    ctx.set_output("Out", count.reshape(1).astype(jnp.int64))
+    ctx.set_output("Out", count.reshape(1).astype(jnp.int32))
 
 
 @register_op("array_to_tensor")
@@ -74,7 +74,7 @@ def array_to_tensor_op(ctx: OpContext):
     stays zero; the count is emitted for masking)."""
     buf, count = ctx.input("Array")
     ctx.set_output("Out", buf)
-    ctx.set_output("OutIndex", count.reshape(1).astype(jnp.int64))
+    ctx.set_output("OutIndex", count.reshape(1).astype(jnp.int32))
 
 
 @register_op("beam_search")
@@ -107,7 +107,7 @@ def beam_search_op(ctx: OpContext):
     flat = total.reshape(B, K * V)
     top_scores, top_idx = jax.lax.top_k(flat, K)  # [B, K]
     sel_ids = (top_idx % V).astype(pre_ids.dtype)
-    parent = (top_idx // V).astype(jnp.int64)
+    parent = (top_idx // V).astype(jnp.int32)
     ctx.set_output("SelectedIds", sel_ids)
     ctx.set_output("SelectedScores", top_scores)
     ctx.set_output("ParentIdx", parent)
@@ -144,7 +144,7 @@ def beam_search_decode_op(ctx: OpContext):
         cur = jnp.where(valid, par_t, cur)
         return cur, out
 
-    init = jnp.tile(jnp.arange(K)[None, :], (B, 1)).astype(jnp.int64)
+    init = jnp.tile(jnp.arange(K)[None, :], (B, 1)).astype(jnp.int32)
     _, outs = jax.lax.scan(back, init, jnp.arange(cap - 1, -1, -1))
     # outs is [cap, B, K] in reverse time order → [B, K, cap] forward
     sent = jnp.flip(outs, axis=0).transpose(1, 2, 0)

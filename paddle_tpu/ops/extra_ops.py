@@ -89,7 +89,7 @@ def sampling_id_op(ctx: OpContext):
     x = ctx.input("X")
     key = ctx.rng()
     ids = jax.random.categorical(key, jnp.log(jnp.maximum(x, 1e-20)), axis=-1)
-    ctx.set_output("Out", ids.astype(jnp.int64))
+    ctx.set_output("Out", ids.astype(jnp.int32))
 
 
 @register_op("random_crop")
@@ -301,9 +301,9 @@ def average_accumulates_op(ctx: OpContext):
     sum1 = ctx.input("InSum1")
     sum2 = ctx.input("InSum2")
     sum3 = ctx.input("InSum3")
-    num_acc = ctx.input("InNumAccumulates").reshape(()).astype(jnp.int64)
-    old_num = ctx.input("InOldNumAccumulates").reshape(()).astype(jnp.int64)
-    num_upd = ctx.input("InNumUpdates").reshape(()).astype(jnp.int64)
+    num_acc = ctx.input("InNumAccumulates").reshape(()).astype(jnp.int32)
+    old_num = ctx.input("InOldNumAccumulates").reshape(()).astype(jnp.int32)
+    num_upd = ctx.input("InNumUpdates").reshape(()).astype(jnp.int32)
     avg_window = float(ctx.attr("average_window", 0.0))
     max_avg = int(ctx.attr("max_average_window", 10000))
     min_avg = int(ctx.attr("min_average_window", 10000))
@@ -318,14 +318,14 @@ def average_accumulates_op(ctx: OpContext):
     # window rollover (average_accumulates_op.h:57): current window done →
     # it BECOMES sum3 (discarding the previous sum3), counts shift.
     window = jnp.minimum(
-        jnp.asarray(max_avg, jnp.int64),
-        (num_upd.astype(jnp.float32) * avg_window).astype(jnp.int64))
+        jnp.asarray(max_avg, jnp.int32),
+        (num_upd.astype(jnp.float32) * avg_window).astype(jnp.int32))
     roll = (num_acc >= min_avg) & (num_acc >= window)
     sum3 = jnp.where(roll, sum1 + sum2, sum3)
     sum1 = jnp.where(roll, jnp.zeros_like(sum1), sum1)
     sum2 = jnp.where(roll, jnp.zeros_like(sum2), sum2)
     old_num = jnp.where(roll, num_acc, old_num)
-    num_acc = jnp.where(roll, jnp.zeros((), jnp.int64), num_acc)
+    num_acc = jnp.where(roll, jnp.zeros((), jnp.int32), num_acc)
 
     ctx.set_output("OutSum1", sum1)
     ctx.set_output("OutSum2", sum2)
@@ -409,3 +409,30 @@ def tree_conv_op(ctx: OpContext):
     col = jnp.einsum("bdnm,bmf->bdnf", coefs, nodes.astype(jnp.float32))
     out = jnp.einsum("bdnf,fdok->bnok", col, filt.astype(jnp.float32))
     ctx.set_output("Out", out.astype(nodes.dtype))
+
+
+@register_op("hash")
+def hash_op(ctx: OpContext):
+    """reference: operators/hash_op.cc — per-row integer hash of the id
+    vector into [0, mod_by), one value per hash seed. The reference uses
+    xxhash over the raw bytes; the TPU-native impl uses a murmur3-style
+    uint32 finalizer folded over the row (same contract: deterministic,
+    well-mixed, mod_by-bounded). X [N, D] int → Out [N, num_hash, 1]."""
+    x = ctx.input("X").astype(jnp.uint32)
+    num_hash = int(ctx.attr("num_hash", 1))
+    mod_by = int(ctx.attr("mod_by", 100000))
+
+    def _mix(h):
+        h = (h ^ (h >> 16)) * jnp.uint32(0x85EBCA6B)
+        h = (h ^ (h >> 13)) * jnp.uint32(0xC2B2AE35)
+        return h ^ (h >> 16)
+
+    outs = []
+    for seed in range(num_hash):
+        h = jnp.full(x.shape[:1], seed + 1, jnp.uint32)
+        for j in range(x.shape[1]):  # static fold over the id row
+            h = _mix(h ^ _mix(x[:, j] + jnp.uint32(0x9E3779B9)))
+        # int32 is exact here (values < mod_by); requesting int64 under
+        # x64-disabled JAX would silently truncate with a warning
+        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int32))
+    ctx.set_output("Out", jnp.stack(outs, axis=1)[:, :, None])

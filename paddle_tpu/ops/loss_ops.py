@@ -253,12 +253,12 @@ def crf_decoding_op(ctx: OpContext):
     path = jnp.concatenate([first[None], path_tail], axis=0)  # [T, B]
     path = jnp.swapaxes(path, 0, 1)
     mask = jnp.arange(t)[None] < length[:, None]
-    path = jnp.where(mask, path, 0).astype(jnp.int64)
+    path = jnp.where(mask, path, 0).astype(jnp.int32)
     if label is not None:
-        lab = label.astype(jnp.int64)
+        lab = label.astype(jnp.int32)
         if lab.ndim == 3:
             lab = lab[..., 0]
-        ctx.set_output("ViterbiPath", jnp.where(mask, (path != lab).astype(jnp.int64), 0))
+        ctx.set_output("ViterbiPath", jnp.where(mask, (path != lab).astype(jnp.int32), 0))
     else:
         ctx.set_output("ViterbiPath", path)
 
@@ -385,4 +385,4 @@ def sample_logits_op(ctx: OpContext):
     ctx.set_output("Probabilities", probs)
     ctx.set_output("SampledLogits", sampled)
     ctx.set_output("SampledLabels",
-                   jnp.broadcast_to(jnp.arange(nt, dtype=jnp.int64)[None], (b, nt)))
+                   jnp.broadcast_to(jnp.arange(nt, dtype=jnp.int32)[None], (b, nt)))

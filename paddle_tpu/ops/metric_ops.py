@@ -102,11 +102,11 @@ def chunk_eval_op(ctx: OpContext):
             ok &= ty != e
         return ok
 
-    n_inf = jnp.sum((ib_i & not_excluded(ty_i)).astype(jnp.int64))
-    n_lab = jnp.sum((ib_l & not_excluded(ty_l)).astype(jnp.int64))
+    n_inf = jnp.sum((ib_i & not_excluded(ty_i)).astype(jnp.int32))
+    n_lab = jnp.sum((ib_l & not_excluded(ty_l)).astype(jnp.int32))
     correct = (ib_i & ib_l & (ty_i == ty_l) & (ep_i == ep_l)
                & not_excluded(ty_i))
-    n_cor = jnp.sum(correct.astype(jnp.int64))
+    n_cor = jnp.sum(correct.astype(jnp.int32))
 
     p = jnp.where(n_inf > 0, n_cor / jnp.maximum(n_inf, 1), 0.0).astype(jnp.float32)
     r = jnp.where(n_lab > 0, n_cor / jnp.maximum(n_lab, 1), 0.0).astype(jnp.float32)
@@ -159,7 +159,7 @@ def edit_distance_op(ctx: OpContext):
     if ctx.attr("normalized", False):
         dist = dist / jnp.maximum(rl.astype(jnp.float32), 1.0)
     ctx.set_output("Out", dist[:, None])
-    ctx.set_output("SequenceNum", jnp.asarray([b], jnp.int64))
+    ctx.set_output("SequenceNum", jnp.asarray([b], jnp.int32))
 
 
 @register_op("precision_recall")

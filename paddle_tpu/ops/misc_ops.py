@@ -106,3 +106,34 @@ def delete_var_op(ctx: OpContext):
     for names in ctx.op.inputs.values():
         for n in names:
             ctx.env.pop(n, None)
+
+
+@register_op("merge_selected_rows")
+def merge_selected_rows_op(ctx: OpContext):
+    """reference: operators/merge_selected_rows_op.cc — sum rows with
+    duplicate ids in a SelectedRows. Static-shape sort+segment-sum
+    (core/sparse.py merge_rows); padded tail ids become out-of-range so a
+    downstream scatter drops them."""
+    from ..core.sparse import SparseGrad, merge_rows
+
+    x = ctx.input("X")
+    if not isinstance(x, SparseGrad):
+        raise TypeError("merge_selected_rows expects a SelectedRows "
+                        "(SparseGrad) value, got %r" % type(x).__name__)
+    uniq, merged = merge_rows(x.ids, x.rows, invalid_index=2**31 - 1)
+    ctx.set_output("Out", SparseGrad(uniq, merged))
+
+
+@register_op("get_tensor_from_selected_rows")
+def get_tensor_from_selected_rows_op(ctx: OpContext):
+    """reference: operators/get_tensor_from_selected_rows_op.cc — expose a
+    SelectedRows' value block as a dense [N, D] tensor (row i holds the
+    contribution of table row ids[i])."""
+    from ..core.sparse import SparseGrad
+
+    x = ctx.input("X")
+    if not isinstance(x, SparseGrad):
+        raise TypeError("get_tensor_from_selected_rows expects a "
+                        "SelectedRows (SparseGrad) value, got %r"
+                        % type(x).__name__)
+    ctx.set_output("Out", x.rows)

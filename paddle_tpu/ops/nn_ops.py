@@ -418,10 +418,35 @@ def _pool_nd(ctx: OpContext, nd: int):
     ksize = list(ctx.attr("ksize", [1] * nd))
     strides = list(ctx.attr("strides", [1] * nd))
     paddings = list(ctx.attr("paddings", [0] * nd))
-    if ctx.attr("global_pooling", False) or ctx.attr("adaptive", False) and all(k == 1 for k in ksize):
+    red = jnp.max if ptype == "max" else jnp.mean
+    if ctx.attr("global_pooling", False) or (
+            ctx.attr("adaptive", False) and all(k == 1 for k in ksize)):
         axes = tuple(range(2, 2 + nd))
-        red = jnp.max if ptype == "max" else jnp.mean
         ctx.set_output("Out", red(x, axis=axes, keepdims=True))
+        return
+    if ctx.attr("adaptive", False):
+        # Adaptive pooling (reference: nn.py adaptive_pool2d/3d lowering to
+        # pool ops with adaptive=True): ksize holds the OUTPUT sizes; window
+        # d covers [floor(i·in/out), ceil((i+1)·in/out)). Divisible dims use
+        # a reshape+reduce (one fused XLA op); ragged dims unroll a static
+        # per-output-slice loop (output sizes are small, e.g. 7).
+        out = x
+        for d, osize in enumerate(int(k) for k in ksize):
+            axis = 2 + d
+            insize = out.shape[axis]
+            if insize % osize == 0:
+                k = insize // osize
+                shp = out.shape[:axis] + (osize, k) + out.shape[axis + 1:]
+                out = red(out.reshape(shp), axis=axis + 1)
+            else:
+                sl = [slice(None)] * out.ndim
+                pieces = []
+                for i in range(osize):
+                    sl[axis] = slice((i * insize) // osize,
+                                     -((-(i + 1) * insize) // osize))
+                    pieces.append(red(out[tuple(sl)], axis=axis))
+                out = jnp.stack(pieces, axis=axis)
+        ctx.set_output("Out", out)
         return
     window = (1, 1) + tuple(ksize)
     stride = (1, 1) + tuple(strides)

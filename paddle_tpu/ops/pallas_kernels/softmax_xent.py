@@ -133,6 +133,11 @@ def _call_fwd(logits, labels, bn, bv, interpret):
 
 
 def _fwd(logits, labels, interpret):
+    if pltpu is None and not interpret:
+        raise RuntimeError(
+            "fused_softmax_xent: pallas TPU backend unavailable on this "
+            "build — gate calls with softmax_xent_supported() or pass "
+            "interpret=True")
     n, v = logits.shape
     labels = labels.reshape(n, 1)
     plog, plab, bn, bv, n_pad, v_pad = _pad(logits, labels)

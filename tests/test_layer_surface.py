@@ -1,0 +1,308 @@
+"""Layer-surface parity sweep (VERDICT round-2 item 4).
+
+Asserts every public def in the reference's ``layers/nn.py`` (155 names) and
+``layers/ops.py`` resolves in ``paddle_tpu.layers``, minus an explicit
+deny-list, and exercises the round-3 additions numerically.
+"""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+REF = "/root/reference/python/paddle/fluid/layers"
+
+# Names intentionally absent, each with a justification.
+DENY_LIST = {
+    # (none — the full nn.py/ops.py surface resolves)
+}
+
+
+def _ref_all(fname):
+    path = os.path.join(REF, fname)
+    if not os.path.exists(path):
+        pytest.skip("reference not available")
+    src = open(path).read()
+    block = re.search(r"__all__ = \[(.*?)\]", src, re.S).group(1)
+    return re.findall(r"'([a-zA-Z0-9_]+)'", block)
+
+
+@pytest.mark.parametrize("fname", ["nn.py", "ops.py"])
+def test_reference_layer_surface_resolves(fname):
+    names = _ref_all(fname)
+    assert len(names) > 50 if fname == "nn.py" else True
+    missing = [n for n in names
+               if n not in DENY_LIST and not hasattr(fluid.layers, n)]
+    assert not missing, "reference %s layers unresolved: %s" % (fname, missing)
+
+
+def _run(main, startup, feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_generated_loss_wrappers(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[5])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        flabel = fluid.layers.data("flabel", shape=[1])
+        left = fluid.layers.data("left", shape=[1])
+        right = fluid.layers.data("right", shape=[1])
+        bpr = fluid.layers.bpr_loss(fluid.layers.softmax(x), label)
+        rl = fluid.layers.rank_loss(flabel, left, right)
+        mrl = fluid.layers.margin_rank_loss(flabel, left, right, margin=0.2)
+    n = 4
+    xs = rng.randn(n, 5).astype("float32")
+    ys = rng.randint(0, 5, (n, 1)).astype("int64")
+    fl = rng.randint(0, 2, (n, 1)).astype("float32")
+    l, r = rng.randn(n, 1).astype("float32"), rng.randn(n, 1).astype("float32")
+    b, rk, m = _run(main, startup,
+                    {"x": xs, "label": ys, "flabel": fl, "left": l, "right": r},
+                    [bpr, rl, mrl])
+    assert b.shape == (n, 1) and np.isfinite(b).all()
+    np.testing.assert_allclose(rk, np.log1p(np.exp(l - r)) - fl * (l - r), rtol=1e-5)
+    np.testing.assert_allclose(m, np.maximum(-fl * (l - r) + 0.2, 0.0), rtol=1e-5)
+
+
+def test_generated_misc_wrappers(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3, 8, 8])
+        pe_in = fluid.layers.data("pe", shape=[4, 6])
+        scale = fluid.layers.data("scale", shape=[3])
+        bias = fluid.layers.data("bias", shape=[3])
+        ac = fluid.layers.affine_channel(x, scale=scale, bias=bias)
+        pe = fluid.layers.add_position_encoding(pe_in, alpha=1.0, beta=1.0)
+        cropped = fluid.layers.crop(x, shape=[2, 3, 4, 4], offsets=[0, 0, 2, 2])
+        rc = fluid.layers.random_crop(x, shape=[4, 4])
+        probs = fluid.layers.data("probs", shape=[6])
+        sid = fluid.layers.sampling_id(probs, dtype="int64")
+        bx = fluid.layers.data("bx", shape=[2], dtype="bool")
+        by = fluid.layers.data("by", shape=[2], dtype="bool")
+        lx = fluid.layers.logical_xor(bx, by)
+    n = 2
+    xs = rng.randn(n, 3, 8, 8).astype("float32")
+    sc = np.array([1.0, 2.0, 3.0], "float32")
+    bi = np.array([0.5, -0.5, 0.0], "float32")
+    pev = rng.randn(n, 4, 6).astype("float32")
+    pr = np.abs(rng.rand(n, 6)).astype("float32")
+    pr /= pr.sum(-1, keepdims=True)
+    bxv = np.array([[True, False], [False, False]])
+    byv = np.array([[True, True], [False, True]])
+    a, p, c, r, s, x_ = _run(
+        main, startup,
+        {"x": xs, "scale": sc, "bias": bi, "pe": pev, "probs": pr,
+         "bx": bxv, "by": byv},
+        [ac, pe, cropped, rc, sid, lx])
+    np.testing.assert_allclose(
+        a, xs * sc.reshape(1, 3, 1, 1) + bi.reshape(1, 3, 1, 1), rtol=1e-5)
+    assert p.shape == pev.shape
+    np.testing.assert_allclose(c, xs[:2, :3, 2:6, 2:6], rtol=1e-6)
+    assert r.shape == (n, 3, 4, 4)
+    assert s.shape == (n,) and (s >= 0).all() and (s < 6).all()
+    np.testing.assert_array_equal(x_, bxv ^ byv)
+
+
+def test_pad_constant_like_and_lod_reset(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4, 5])
+        y = fluid.layers.data("y", shape=[2, 3])
+        padded = fluid.layers.pad_constant_like(x, y, pad_value=7.0)
+        lr, lr_len = fluid.layers.lod_reset(x, target_lod=[0, 2, 3])
+    xs = rng.randn(3, 4, 5).astype("float32")
+    ys = rng.randn(3, 2, 3).astype("float32")
+    p, out = _run(main, startup, {"x": xs, "y": ys}, [padded, lr])
+    assert p.shape == xs.shape
+    np.testing.assert_allclose(p[:, :2, :3], ys, rtol=1e-6)
+    assert (p[:, 2:, :] == 7.0).all()
+    np.testing.assert_allclose(out, xs, rtol=1e-6)
+
+
+def test_adaptive_pools(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2, 8, 6])
+        v = fluid.layers.data("v", shape=[2, 4, 6, 5])
+        avg = fluid.layers.adaptive_pool2d(x, pool_size=[4, 3], pool_type="avg")
+        mx = fluid.layers.adaptive_pool2d(x, pool_size=[3, 5], pool_type="max")
+        p3 = fluid.layers.adaptive_pool3d(v, pool_size=[2, 3, 5], pool_type="avg")
+    xs = rng.randn(2, 2, 8, 6).astype("float32")
+    vs = rng.randn(2, 2, 4, 6, 5).astype("float32")
+    a, m, p = _run(main, startup, {"x": xs, "v": vs}, [avg, mx, p3])
+    # divisible dims: reshape-reduce parity with numpy
+    np.testing.assert_allclose(
+        a, xs.reshape(2, 2, 4, 2, 3, 2).mean(axis=(3, 5)), rtol=1e-5)
+    assert m.shape == (2, 2, 3, 5)
+    # ragged windows: [floor(i*in/out), ceil((i+1)*in/out))
+    for i in range(3):
+        s, e = (i * 8) // 3, -((-(i + 1) * 8) // 3)
+        np.testing.assert_allclose(
+            m[:, :, i, :],
+            np.stack([xs[:, :, s:e, (j * 6) // 5: -((-(j + 1) * 6) // 5)]
+                      .max(axis=(2, 3)) for j in range(5)], axis=-1),
+            rtol=1e-5)
+    assert p.shape == (2, 2, 2, 3, 5)
+
+
+def test_dice_loss_and_image_resize_short(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pred = fluid.layers.data("pred", shape=[4])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        dl = fluid.layers.dice_loss(fluid.layers.softmax(pred), label)
+        img = fluid.layers.data("img", shape=[3, 12, 24])
+        short = fluid.layers.image_resize_short(img, out_short_len=6)
+    ps = rng.randn(5, 4).astype("float32")
+    ls = rng.randint(0, 4, (5, 1)).astype("int64")
+    ims = rng.randn(2, 3, 12, 24).astype("float32")
+    d, s = _run(main, startup, {"pred": ps, "label": ls, "img": ims}, [dl, short])
+    assert 0.0 <= float(d) <= 1.0 + 1e-5
+    assert s.shape == (2, 3, 6, 12)
+
+
+def test_sampled_softmax_trains(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[16])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        logits = fluid.layers.fc(x, size=64)
+        loss = fluid.layers.mean(
+            fluid.layers.sampled_softmax_with_cross_entropy(
+                logits, label, num_samples=8))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    centers = rng.randn(4, 16).astype("float32") * 2
+    first = last = None
+    for i in range(60):
+        ys = rng.randint(0, 4, 32)
+        xs = centers[ys] + 0.3 * rng.randn(32, 16).astype("float32")
+        (lv,) = exe.run(main, feed={"x": xs.astype("float32"),
+                                    "label": ys.reshape(-1, 1).astype("int64")},
+                        fetch_list=[loss])
+        if first is None:
+            first = float(lv)
+        last = float(lv)
+    assert last < first, "sampled softmax did not reduce loss (%s -> %s)" % (first, last)
+
+
+def test_hash_layer(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[2], dtype="int64")
+        h = fluid.layers.hash(ids, hash_size=1000, num_hash=3)
+    v = rng.randint(0, 10**6, (8, 2)).astype("int64")
+    (out,) = _run(main, startup, {"ids": v}, [h])
+    assert out.shape == (8, 3, 1)
+    assert (out >= 0).all() and (out < 1000).all()
+    # deterministic + different seeds give different streams
+    (out2,) = _run(main, startup, {"ids": v}, [h])
+    np.testing.assert_array_equal(out, out2)
+    assert not (out[:, 0] == out[:, 1]).all()
+
+
+def test_selected_rows_helpers():
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.registry import get_op_impl, OpContext
+    from paddle_tpu.core.sparse import SparseGrad
+
+    class _Op:
+        def __init__(self, type_, inputs, outputs):
+            self.type, self.inputs, self.outputs, self.attrs = (
+                type_, inputs, outputs, {})
+
+    ids = jnp.array([3, 1, 3, 2], jnp.int32)
+    rows = jnp.arange(8, dtype=jnp.float32).reshape(4, 2)
+    env = {"x": SparseGrad(ids, rows)}
+    get_op_impl("merge_selected_rows")(OpContext(
+        _Op("merge_selected_rows", {"X": ["x"]}, {"Out": ["m"]}), env, None))
+    m = env["m"]
+    # id 3 appears twice: rows 0 and 2 summed
+    got = {int(i): np.asarray(m.rows)[j] for j, i in enumerate(m.ids) if i < 2**31 - 1}
+    np.testing.assert_allclose(got[3], np.asarray(rows[0] + rows[2]))
+    np.testing.assert_allclose(got[1], np.asarray(rows[1]))
+    np.testing.assert_allclose(got[2], np.asarray(rows[3]))
+    get_op_impl("get_tensor_from_selected_rows")(OpContext(
+        _Op("get_tensor_from_selected_rows", {"X": ["x"]}, {"Out": ["t"]}),
+        env, None))
+    np.testing.assert_allclose(np.asarray(env["t"]), np.asarray(rows))
+
+
+def test_spectral_norm_normalizes(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        w = fluid.layers.data("w", shape=[6, 4], append_batch_size=False)
+        sn = fluid.layers.spectral_norm(w, dim=0, power_iters=20)
+    ws = rng.randn(3, 6, 4).astype("float32")[0]  # op expects the raw matrix
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (out,) = exe.run(main, feed={"w": ws}, fetch_list=[sn])
+    sigma = np.linalg.svd(ws, compute_uv=False)[0]
+    np.testing.assert_allclose(out, ws / sigma, rtol=1e-3, atol=1e-4)
+
+
+def test_sequence_conv_and_reshape(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[6, 4])
+        length = fluid.layers.data("len", shape=[], dtype="int32")
+        out = fluid.layers.sequence_conv(x, num_filters=8, filter_size=3,
+                                         length=length, bias_attr=False)
+        rs = fluid.layers.sequence_reshape(x, new_dim=2)
+    xs = rng.randn(2, 6, 4).astype("float32")
+    ln = np.array([6, 3], "int32")
+    o, r = _run(main, startup, {"x": xs, "len": ln}, [out, rs])
+    assert o.shape == (2, 6, 8)
+    assert r.shape == (2, 12, 2)
+    np.testing.assert_allclose(r.reshape(2, 6, 4), xs, rtol=1e-6)
+
+
+def test_conv3d_transpose_and_tree_conv_build(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        v = fluid.layers.data("v", shape=[2, 4, 4, 4])
+        up = fluid.layers.conv3d_transpose(v, num_filters=3, filter_size=2,
+                                           stride=2, bias_attr=False)
+        nodes = fluid.layers.data("nodes", shape=[5, 6])
+        edges = fluid.layers.data("edges", shape=[4, 2], dtype="int32")
+        tc = fluid.layers.tree_conv(nodes, edges, output_size=7,
+                                    num_filters=2, bias_attr=False)
+    vs = rng.randn(1, 2, 4, 4, 4).astype("float32")
+    ns = rng.randn(1, 5, 6).astype("float32")
+    es = np.array([[[1, 2], [1, 3], [2, 4], [0, 0]]], "int32")
+    u, t = _run(main, startup, {"v": vs, "nodes": ns, "edges": es}, [up, tc])
+    assert u.shape == (1, 3, 8, 8, 8)
+    assert t.shape == (1, 5, 7, 2)
+
+
+def test_affine_grid_and_similarity_focus(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        theta = fluid.layers.data("theta", shape=[2, 3])
+        grid = fluid.layers.affine_grid(theta, out_shape=[2, 1, 4, 5])
+        x = fluid.layers.data("x", shape=[3, 4, 4])
+        sf = fluid.layers.similarity_focus(x, axis=1, indexes=[0, 2])
+    th = np.tile(np.array([[[1, 0, 0], [0, 1, 0]]], "float32"), (2, 1, 1))
+    xs = np.abs(rng.randn(2, 3, 4, 4)).astype("float32")
+    g, s = _run(main, startup, {"theta": th, "x": xs}, [grid, sf])
+    assert g.shape == (2, 4, 5, 2)
+    assert s.shape == xs.shape and set(np.unique(s)).issubset({0.0, 1.0})
+
+
+def test_teacher_student_loss_runs(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1])
+        label = fluid.layers.data("label", shape=[1])
+        loss = fluid.layers.teacher_student_sigmoid_loss(x, label)
+    xs = rng.randn(6, 1).astype("float32")
+    ls = rng.rand(6, 1).astype("float32")
+    (out,) = _run(main, startup, {"x": xs, "label": ls}, [loss])
+    assert np.isfinite(out).all()

@@ -133,7 +133,9 @@ def test_py_reader_source_error_propagates(rng):
 
 
 def test_explicit_feed_wins_over_reader(rng):
-    """A caller-supplied feed for a reader var must not be clobbered."""
+    """A FULL explicit feed bypasses the queue; a PARTIAL one raises (mixing
+    queue arrays with caller rows would silently pair unrelated batches —
+    round-2 advisor finding)."""
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         reader, loss = _build(via_reader=True)
@@ -144,10 +146,14 @@ def test_explicit_feed_wins_over_reader(rng):
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
     reader.start()
+    custom_x = rng.randn(4, 16).astype("float32")
     custom_y = np.ones((4, 1), "int64")
-    lab_val, = exe.run(main, feed={lab_name: custom_y}, fetch_list=[lab_name])
+    lab_val, = exe.run(main, feed={img_name: custom_x, lab_name: custom_y},
+                       fetch_list=[lab_name])
     np.testing.assert_array_equal(
         lab_val, custom_y), "explicit feed was clobbered by the reader queue"
+    with pytest.raises(ValueError, match="feed all of them or none"):
+        exe.run(main, feed={lab_name: custom_y}, fetch_list=[lab_name])
 
 
 def test_py_reader_requires_start(rng):
