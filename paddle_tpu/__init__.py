@@ -52,6 +52,14 @@ from .core.framework import (  # noqa: F401
     test_mode,
 )
 from .core import unique_name  # noqa: F401
+from .parallel_executor import ParallelExecutor  # noqa: F401
+from .core.pass_framework import (  # noqa: F401
+    Pass,
+    PassBuilder,
+    get_pass,
+    register_pass,
+    registered_passes,
+)
 from .core.place import CPUPlace, CUDAPinnedPlace, TPUPlace, is_compiled_with_tpu  # noqa: F401
 from .core.scope import Scope, global_scope, scope_guard  # noqa: F401
 from .executor import Executor  # noqa: F401

@@ -96,6 +96,18 @@ class BuildStrategy(_StrategyBase):
         object.__setattr__(self, "_defaults", d)
         for k, v in d.items():
             object.__setattr__(self, k, v)
+        object.__setattr__(self, "_pass_builder", None)
+
+    def pass_builder(self):
+        """The Program-pass pipeline applied in CompiledProgram's build step
+        (reference: BuildStrategy::CreatePassesFromStrategy + PassBuilder,
+        pybind.cc:981-1003). Created empty on first call; append registered
+        or custom passes."""
+        from .core.pass_framework import PassBuilder
+
+        if self._pass_builder is None:
+            object.__setattr__(self, "_pass_builder", PassBuilder())
+        return self._pass_builder
 
 
 class CompiledProgram:
@@ -159,8 +171,31 @@ class CompiledProgram:
             self._mesh_cache = Mesh(devices, axis_names=("data",))
         return self._mesh_cache
 
+    # -- build-step passes ----------------------------------------------------
+    def _apply_build_passes(self, scope):
+        """Run the BuildStrategy's PassBuilder pipeline once, at first
+        execution (the reference applies its pass pipeline when the
+        ParallelExecutor graph is built, build_strategy.cc:44-150)."""
+        if getattr(self, "_passes_applied", False):
+            return
+        bs = self._build_strategy
+        builder = getattr(bs, "_pass_builder", None) if bs is not None else None
+        if builder is None:
+            self._passes_applied = True
+            return
+        from .core.scope import global_scope
+
+        for p in builder.all_passes():
+            if not p.has_attr("scope"):
+                p.set_attr("scope", scope if scope is not None else global_scope())
+        self._program = builder.apply_all(self._program)
+        # only after success: a failed pass must re-run next time, not be
+        # silently skipped
+        self._passes_applied = True
+
     # -- execution (called from Executor.run) ---------------------------------
     def _run(self, executor, feed, fetch_list, scope, return_numpy):
+        self._apply_build_passes(scope)
         accum = 1
         if self._build_strategy is not None:
             accum = getattr(self._build_strategy, "gradient_accumulation_steps", 1)

@@ -1,11 +1,20 @@
 """Model-compression namespace (reference: contrib/slim/ — quantization,
-distillation, pruning behind a Compressor config).
+pruning, distillation behind a Compressor config).
 
-Quantization is real (see quantization/): the graph passes delegate to the
-QuantizeTranspiler machinery (contrib/quantize) over Program IR. The
-reference's distillation/pruning strategies are config-driven wrappers over
-ordinary layers (losses + mask ops) — compose them directly; there is no
-hidden runtime to port.
+Quantization delegates to the QuantizeTranspiler machinery over Program IR
+via registered framework passes; pruning operates on scope values directly
+(host-visible arrays need no mask programs); distillation losses are layer
+compositions. The Compressor (core/) drives Strategy callbacks per
+epoch/batch like the reference CompressPass.
 """
 
-from . import quantization  # noqa: F401
+from . import distillation, quantization  # noqa: F401
+from .core import CompressPass, Context, Strategy, build_compressor  # noqa: F401
+from .graph import Graph, ImitationGraph  # noqa: F401
+from .prune import (  # noqa: F401
+    MagnitudePruner,
+    PruneStrategy,
+    Pruner,
+    RatioPruner,
+    SensitivePruneStrategy,
+)

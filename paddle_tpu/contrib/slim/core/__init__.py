@@ -1,0 +1,2 @@
+from .compress_pass import CompressPass, Context, build_compressor  # noqa: F401
+from .strategy import Strategy  # noqa: F401

@@ -1,0 +1,6 @@
+from .distillation_strategy import (  # noqa: F401
+    DistillationStrategy,
+    fsp_loss,
+    l2_distill_loss,
+    soft_label_loss,
+)

@@ -1,0 +1,2 @@
+from .pruner import MagnitudePruner, Pruner, RatioPruner  # noqa: F401
+from .prune_strategy import PruneStrategy, SensitivePruneStrategy  # noqa: F401

@@ -1,62 +1,83 @@
-"""Pass-styled quantization API (reference:
+"""Quantization passes on the Program-pass framework (reference:
 contrib/slim/quantization/quantization_pass.py — QuantizationTransformPass,
 QuantizationFreezePass, ConvertToInt8Pass over IrGraph).
 
-Our IR is the Program itself, so each pass applies the corresponding phase
-of the QuantizeTranspiler (contrib/quantize/quantize_transpiler.py) — same
-rewrites, pass-shaped interface.
+Our IR is the Program itself, so each pass is a registered
+``core.pass_framework.Pass`` applying the corresponding phase of the
+QuantizeTranspiler (contrib/quantize/quantize_transpiler.py) — same
+rewrites, composable in a PassBuilder pipeline alongside user passes.
 """
 
 from __future__ import annotations
 
+from ....core.pass_framework import Pass, register_pass
 from ...quantize.quantize_transpiler import QuantizeTranspiler
 
 __all__ = ["QuantizationTransformPass", "QuantizationFreezePass",
            "ConvertToInt8Pass"]
 
 
-class QuantizationTransformPass:
-    """reference: quantization_pass.py QuantizationTransformPass."""
+@register_pass("quantization_transform_pass")
+class QuantizationTransformPass(Pass):
+    """Insert fake quant/dequant around quantizable ops (QAT training phase)."""
 
     def __init__(self, scope=None, place=None, weight_bits=8, activation_bits=8,
                  activation_quantize_type="abs_max",
                  weight_quantize_type="abs_max", window_size=10000,
                  moving_rate=0.9):
+        super().__init__()
         self._t = QuantizeTranspiler(
             weight_bits=weight_bits, activation_bits=activation_bits,
             activation_quantize_type=activation_quantize_type,
             weight_quantize_type=weight_quantize_type,
             window_size=window_size, moving_rate=moving_rate)
-        self._scope = scope
-        self._place = place
+        if scope is not None:
+            self.set_attr("scope", scope)
+        if place is not None:
+            self.set_attr("place", place)
 
-    def apply(self, program, startup_program=None):
-        """Insert fake quant/dequant around quantizable ops (QAT)."""
-        return self._t.training_transpile(program, startup_program)
+    def apply(self, program, startup_program=None):  # reference signature
+        # always overwrite: a stale startup from a previous apply() would
+        # receive this program's scale-initializer ops
+        self.set_attr("startup_program", startup_program)
+        return super().apply(program)
+
+    def apply_impl(self, program):
+        return self._t.training_transpile(program, self.attr("startup_program"))
 
 
-class QuantizationFreezePass:
-    """reference: quantization_pass.py QuantizationFreezePass."""
+@register_pass("quantization_freeze_pass")
+class QuantizationFreezePass(Pass):
+    """Fold trained quant scales into inference-time quantize ops."""
 
     def __init__(self, scope=None, place=None, weight_bits=8, activation_bits=8,
                  weight_quantize_type="abs_max"):
+        super().__init__()
         self._t = QuantizeTranspiler(
             weight_bits=weight_bits, activation_bits=activation_bits,
             weight_quantize_type=weight_quantize_type)
-        self._scope = scope
-        self._place = place
+        if scope is not None:
+            self.set_attr("scope", scope)
+        if place is not None:
+            self.set_attr("place", place)
 
-    def apply(self, program):
-        return self._t.freeze_program(program, self._place, self._scope)
+    def apply_impl(self, program):
+        return self._t.freeze_program(program, self.attr("place"),
+                                      self.attr("scope"))
 
 
-class ConvertToInt8Pass:
-    """reference: quantization_pass.py ConvertToInt8Pass."""
+@register_pass("convert_to_int8_pass")
+class ConvertToInt8Pass(Pass):
+    """Store weights as int8 for the frozen inference program."""
 
     def __init__(self, scope=None, place=None):
+        super().__init__()
         self._t = QuantizeTranspiler()
-        self._scope = scope
-        self._place = place
+        if scope is not None:
+            self.set_attr("scope", scope)
+        if place is not None:
+            self.set_attr("place", place)
 
-    def apply(self, program):
-        return self._t.convert_to_int8(program, self._place, self._scope)
+    def apply_impl(self, program):
+        return self._t.convert_to_int8(program, self.attr("place"),
+                                       self.attr("scope"))

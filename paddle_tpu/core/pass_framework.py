@@ -1,0 +1,149 @@
+"""User-extensible Program-pass framework.
+
+Reference: ``framework/ir/pass.h:32`` (Pass base), ``REGISTER_PASS``
+(``pass.h:207``), and the PassBuilder exposed at ``pybind/pybind.cc:981-1003``
+(``BuildStrategy::CreatePassesFromStrategy`` / append/insert/remove).
+
+The reference's passes rewrite an ``ir::Graph`` lowered from ProgramDesc; the
+TPU-native IR *is* the Program (Block/Operator/Variable,
+``core/framework.py``), and XLA owns kernel-level fusion — so Program passes
+here are for the rewrites XLA cannot do: quantization instrumentation,
+inference-time weight folding (conv+bn), pruning, user instrumentation.
+Passes run in PassBuilder order inside ``CompiledProgram``'s build step, or
+standalone via ``Pass.apply``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+__all__ = ["Pass", "FunctionPass", "register_pass", "get_pass", "has_pass",
+           "registered_passes", "PassBuilder"]
+
+
+class Pass:
+    """Base class. Subclasses set ``name`` (or get it from ``register_pass``)
+    and implement ``apply_impl(program)``; mutate the program in place and/or
+    return it (returning None means "mutated in place").
+
+    Like the reference's ``Pass::Set/Get`` attribute bag (``pass.h:51-99``),
+    ``set_attr``/``attr`` carry side inputs such as the Scope holding
+    parameter values (weight-folding passes need them).
+    """
+
+    name: str = ""
+
+    def __init__(self):
+        self._attrs: Dict[str, Any] = {}
+
+    # -- attribute bag --------------------------------------------------------
+    def set_attr(self, key: str, value) -> "Pass":
+        self._attrs[key] = value
+        return self
+
+    def attr(self, key: str, default=None):
+        return self._attrs.get(key, default)
+
+    def has_attr(self, key: str) -> bool:
+        return key in self._attrs
+
+    # -- application ----------------------------------------------------------
+    def apply(self, program):
+        out = self.apply_impl(program)
+        program = out if out is not None else program
+        program._version += 1  # invalidate executor program caches
+        return program
+
+    def apply_impl(self, program):
+        raise NotImplementedError(
+            "Pass %r must implement apply_impl(program)" % type(self).__name__)
+
+    def __repr__(self):
+        return "<Pass %s>" % (self.name or type(self).__name__)
+
+
+class FunctionPass(Pass):
+    """Adapter: a plain ``fn(program, pass_) -> Program|None`` as a Pass."""
+
+    def __init__(self, name: str, fn: Callable):
+        super().__init__()
+        self.name = name
+        self._fn = fn
+
+    def apply_impl(self, program):
+        return self._fn(program, self)
+
+
+_PASS_REGISTRY: Dict[str, Callable[[], Pass]] = {}
+
+
+def register_pass(name: str):
+    """Decorator registering a Pass subclass or a function
+    (reference: REGISTER_PASS, ir/pass.h:207). Re-registration under the
+    same name is an error, matching the reference's static-registrar check."""
+
+    def deco(obj):
+        if name in _PASS_REGISTRY:
+            raise ValueError("pass %r registered twice" % name)
+        if isinstance(obj, type) and issubclass(obj, Pass):
+            obj.name = name
+            _PASS_REGISTRY[name] = obj
+        elif callable(obj):
+            _PASS_REGISTRY[name] = lambda: FunctionPass(name, obj)
+        else:
+            raise TypeError("register_pass: need a Pass subclass or callable")
+        return obj
+
+    return deco
+
+
+def get_pass(name: str) -> Pass:
+    try:
+        factory = _PASS_REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            "pass %r is not registered (known: %s)"
+            % (name, sorted(_PASS_REGISTRY))) from None
+    return factory()
+
+
+def has_pass(name: str) -> bool:
+    return name in _PASS_REGISTRY
+
+
+def registered_passes() -> List[str]:
+    return sorted(_PASS_REGISTRY)
+
+
+class PassBuilder:
+    """Ordered pass pipeline (reference: PassBuilder at pybind.cc:981-1003:
+    append_pass/insert_pass/remove_pass over BuildStrategy's pipeline)."""
+
+    def __init__(self, passes: Optional[List[Union[str, Pass]]] = None):
+        self._passes: List[Pass] = []
+        for p in passes or []:
+            self.append_pass(p)
+
+    def _coerce(self, p: Union[str, Pass]) -> Pass:
+        return get_pass(p) if isinstance(p, str) else p
+
+    def append_pass(self, p: Union[str, Pass]) -> Pass:
+        p = self._coerce(p)
+        self._passes.append(p)
+        return p
+
+    def insert_pass(self, idx: int, p: Union[str, Pass]) -> Pass:
+        p = self._coerce(p)
+        self._passes.insert(idx, p)
+        return p
+
+    def remove_pass(self, idx: int) -> None:
+        del self._passes[idx]
+
+    def all_passes(self) -> List[Pass]:
+        return list(self._passes)
+
+    def apply_all(self, program):
+        for p in self._passes:
+            program = p.apply(program)
+        return program

@@ -1,16 +1,23 @@
 from .distribute_transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
+from .fuse_passes import ConvBNFusePass  # noqa: F401
 from .memory_optimization import memory_optimize, release_memory  # noqa: F401
 
 
 class InferenceTranspiler:
-    """Compat shim (reference: transpiler/inference_transpiler.py — BN fold,
-    conv+BN fuse, relu fuse for CPU/MKLDNN inference). Under XLA these
-    algebraic fusions happen in the compiler for every jitted program, so
-    transpile is the identity; kept so reference inference scripts run
-    unchanged."""
+    """reference: transpiler/inference_transpiler.py — pre-deploy program
+    rewrites. Elementwise/act fusion is XLA's job for every jitted program;
+    the cross-op WEIGHT folds are not, so transpile runs the conv+bn fold
+    pass (transpiler/fuse_passes.py) when a scope with parameter values is
+    available, and is the identity otherwise."""
 
     def transpile(self, program, place=None, scope=None):
-        return program
+        if scope is None:
+            from ..core.scope import global_scope
+
+            scope = global_scope()
+        from ..core.pass_framework import get_pass
+
+        return get_pass("conv_bn_fuse_pass").set_attr("scope", scope).apply(program)
 
 
 __all__ = [

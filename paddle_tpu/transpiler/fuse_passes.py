@@ -1,0 +1,127 @@
+"""Inference-time fusion passes on the Program-pass framework.
+
+Reference: ``framework/ir/conv_bn_fuse_pass.cc`` (+ its tester pattern,
+``ir/fc_fuse_pass_tester.cc``: build a tiny program, apply, assert fused
+node counts). XLA already fuses elementwise chains into convs at compile
+time — what it cannot do is *fold weights across ops*, because the conv
+filter and the BN statistics are separate runtime inputs to the compiled
+step. Folding W' = W·γ/√(σ²+ε), b' = β + (b−μ)·γ/√(σ²+ε) removes the BN op
+and its four parameter reads entirely, which is the reference pass's win and
+is equally real on TPU (fewer HBM reads, one less kernel input).
+
+Only valid for inference programs (BN in global-stats mode): the pass
+requires the op to run with ``is_test``/``use_global_stats`` semantics.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.pass_framework import Pass, register_pass
+
+__all__ = ["ConvBNFusePass"]
+
+
+@register_pass("conv_bn_fuse_pass")
+class ConvBNFusePass(Pass):
+    """Fold batch_norm into the preceding conv2d's weights.
+
+    Requires attrs:
+      - ``scope``: the Scope holding parameter values (weights are folded
+        numerically, like the reference InferenceTranspiler).
+    Matches: conv2d → [elementwise_add bias] → batch_norm, where each
+    intermediate is consumed only by the next op in the chain.
+    """
+
+    def apply_impl(self, program):
+        scope = self.attr("scope")
+        if scope is None:
+            raise ValueError(
+                "conv_bn_fuse_pass needs set_attr('scope', scope) — weight "
+                "folding reads/writes parameter values")
+        block = program.global_block
+        ops = block.ops
+
+        def consumers(name, upto=None):
+            return [o for o in ops if any(
+                name in ns for ns in o.inputs.values())]
+
+        fused = 0
+        i = 0
+        while i < len(ops):
+            bn = ops[i]
+            if bn.type != "batch_norm":
+                i += 1
+                continue
+            if not (bn.attrs.get("is_test") or bn.attrs.get("use_global_stats")):
+                i += 1
+                continue
+            x_name = bn.inputs["X"][0]
+            producer = next((o for o in ops if any(
+                x_name in ns for ns in o.outputs.values())), None)
+            if producer is None or len(consumers(x_name)) != 1:
+                i += 1
+                continue
+            bias_op = None
+            if producer.type == "elementwise_add":
+                bias_op = producer
+                conv_out = bias_op.inputs["X"][0]
+                conv = next((o for o in ops if o.type == "conv2d" and
+                             conv_out in o.outputs.get("Output", ())), None)
+                if conv is None or len(consumers(conv_out)) != 1:
+                    i += 1
+                    continue
+                # the add must be a per-channel BIAS, not a residual/shortcut
+                # add: Y is a 1-D var with a value in the scope
+                y_var = block._find_var_recursive(bias_op.inputs["Y"][0])
+                if (y_var is None or y_var.shape is None
+                        or len(y_var.shape) != 1
+                        or scope.find_var(bias_op.inputs["Y"][0]) is None):
+                    i += 1
+                    continue
+            elif producer.type == "conv2d":
+                conv = producer
+            else:
+                i += 1
+                continue
+
+            w_name = conv.inputs["Filter"][0]
+            vals = [scope.find_var(n) for n in (
+                bn.inputs["Scale"][0], bn.inputs["Bias"][0],
+                bn.inputs["Mean"][0], bn.inputs["Variance"][0], w_name)]
+            if any(v is None for v in vals):
+                # parameters not materialized (e.g. transpile before startup
+                # ran) — leave this candidate alone rather than crash
+                i += 1
+                continue
+            gamma, beta, mu, var, w = (np.asarray(v) for v in vals)
+            eps = float(bn.attrs.get("epsilon", 1e-5))
+            inv_std = gamma / np.sqrt(var + eps)
+
+            scope.set_var(w_name, (w * inv_std.reshape(-1, 1, 1, 1)).astype(w.dtype))
+            bn_y = bn.outputs["Y"][0]
+            if bias_op is not None:
+                b_name = bias_op.inputs["Y"][0]
+                b = np.asarray(scope.find_var(b_name))
+                scope.set_var(b_name,
+                              (beta + (b - mu) * inv_std).astype(b.dtype))
+                bias_op.outputs["Out"] = [bn_y]
+            else:
+                # conv had no bias: the folded β − μ·γ/√(σ²+ε) becomes one,
+                # written straight into the scope (inference programs don't
+                # re-run startup).
+                b_name = w_name + ".bn_fold_bias"
+                block.create_parameter(
+                    name=b_name, shape=[int(beta.shape[0])],
+                    dtype=str(beta.dtype), trainable=False, persistable=True)
+                scope.set_var(b_name, (beta - mu * inv_std).astype(beta.dtype))
+                bias_var = block.var(b_name)
+                idx = ops.index(bn)
+                block.insert_op(
+                    idx, "elementwise_add",
+                    inputs={"X": conv.outputs["Output"][0], "Y": bias_var},
+                    outputs={"Out": bn_y}, attrs={"axis": 1})
+            block.remove_op(ops.index(bn))
+            fused += 1
+        self.set_attr("fused_count", fused)
+        return program

@@ -1,0 +1,170 @@
+"""Program-pass framework (reference: ir/pass.h:32, REGISTER_PASS pass.h:207,
+PassBuilder pybind.cc:981-1003; tester pattern: ir/fc_fuse_pass_tester.cc —
+build a tiny program, apply, assert fused node counts)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.pass_framework import (
+    FunctionPass, Pass, PassBuilder, get_pass, has_pass, register_pass)
+
+
+def _count_ops(program, op_type):
+    return sum(1 for op in program.global_block.ops if op.type == op_type)
+
+
+def test_registry_and_builder_order():
+    calls = []
+
+    @register_pass("test_pass_a")
+    def pass_a(program, p):
+        calls.append("a")
+
+    @register_pass("test_pass_b")
+    class PassB(Pass):
+        def apply_impl(self, program):
+            calls.append("b")
+
+    assert has_pass("test_pass_a") and has_pass("test_pass_b")
+    with pytest.raises(ValueError, match="registered twice"):
+        register_pass("test_pass_a")(lambda program, p: None)
+    with pytest.raises(KeyError, match="not registered"):
+        get_pass("no_such_pass")
+
+    builder = PassBuilder(["test_pass_b"])
+    builder.insert_pass(0, "test_pass_a")
+    builder.append_pass(FunctionPass("inline", lambda prog, p: calls.append("c")))
+    assert [p.name for p in builder.all_passes()] == [
+        "test_pass_a", "test_pass_b", "inline"]
+    builder.remove_pass(2)
+    prog = fluid.Program()
+    builder.apply_all(prog)
+    assert calls == ["a", "b"]
+
+
+def test_user_pass_runs_in_compiled_program_build(rng):
+    """A user-registered custom pass plugged into BuildStrategy's
+    PassBuilder runs during CompiledProgram's build step (VERDICT item 5's
+    'done' criterion)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.fc(x, size=3, act="relu")
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    seen = {}
+
+    class CountOpsPass(Pass):
+        name = "count_ops_pass"
+
+        def apply_impl(self, program):
+            seen["ops"] = len(program.global_block.ops)
+            seen["scope_is_set"] = self.attr("scope") is not None
+
+    bs = fluid.compiler.BuildStrategy()
+    bs.pass_builder().append_pass(CountOpsPass())
+    compiled = fluid.compiler.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, build_strategy=bs)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = rng.randn(8, 4).astype("float32")
+    exe.run(compiled, feed={"x": xs}, fetch_list=[loss])
+    assert seen["ops"] > 0 and seen["scope_is_set"]
+    # passes run once per compiled program, not once per step
+    seen.clear()
+    exe.run(compiled, feed={"x": xs}, fetch_list=[loss])
+    assert seen == {}
+
+
+def _build_conv_bn(bias):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 8, 8])
+        c = fluid.layers.conv2d(img, num_filters=5, filter_size=3,
+                                bias_attr=None if bias else False)
+        out = fluid.layers.batch_norm(c, is_test=True)
+        out = fluid.layers.relu(out)
+    return main, startup, out
+
+
+@pytest.mark.parametrize("bias", [True, False])
+def test_conv_bn_fuse_numeric_parity(rng, bias):
+    main, startup, out = _build_conv_bn(bias)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.global_scope()
+    exe.run(startup)
+    # make BN stats non-trivial so the fold actually moves numbers
+    for p in main.list_vars():
+        if p.name.endswith(".mean"):
+            scope.set_var(p.name, rng.randn(5).astype("float32") * 0.1)
+        if p.name.endswith(".var"):
+            scope.set_var(p.name, np.abs(rng.randn(5)).astype("float32") + 0.5)
+    xs = rng.randn(2, 3, 8, 8).astype("float32")
+    (before,) = exe.run(main, feed={"img": xs}, fetch_list=[out])
+
+    p = get_pass("conv_bn_fuse_pass").set_attr("scope", scope)
+    p.apply(main)
+    assert p.attr("fused_count") == 1
+    assert _count_ops(main, "batch_norm") == 0
+    assert _count_ops(main, "conv2d") == 1
+    (after,) = exe.run(main, feed={"img": xs}, fetch_list=[out])
+    np.testing.assert_allclose(after, before, rtol=2e-4, atol=2e-5)
+
+
+def test_conv_bn_fuse_skips_training_bn(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 8, 8])
+        c = fluid.layers.conv2d(img, num_filters=4, filter_size=3)
+        fluid.layers.batch_norm(c)  # training-mode BN: batch stats, no fold
+    p = get_pass("conv_bn_fuse_pass").set_attr("scope", fluid.global_scope())
+    p.apply(main)
+    assert p.attr("fused_count") == 0
+    assert _count_ops(main, "batch_norm") == 1
+
+
+def test_conv_bn_fuse_skips_residual_add(rng):
+    # conv → add(shortcut activation) → bn must NOT be treated as conv+bias
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[4, 8, 8])
+        c = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                padding=1, bias_attr=False)
+        summed = fluid.layers.elementwise_add(c, img)  # residual, not bias
+        fluid.layers.batch_norm(summed, is_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    p = get_pass("conv_bn_fuse_pass").set_attr("scope", fluid.global_scope())
+    p.apply(main)
+    assert p.attr("fused_count") == 0
+    assert _count_ops(main, "batch_norm") == 1
+
+
+def test_fuse_pass_before_startup_is_noop(rng):
+    # params not materialized yet → candidates are skipped, not crashed on
+    main, startup, out = _build_conv_bn(bias=True)
+    with fluid.scope_guard(fluid.Scope()):
+        p = get_pass("conv_bn_fuse_pass").set_attr("scope", fluid.global_scope())
+        p.apply(main)
+        assert p.attr("fused_count") == 0
+    assert _count_ops(main, "batch_norm") == 1
+
+
+def test_inference_transpiler_uses_fuse_pass(rng):
+    main, startup, out = _build_conv_bn(bias=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    t = fluid.transpiler.InferenceTranspiler()
+    t.transpile(main, scope=fluid.global_scope())
+    assert _count_ops(main, "batch_norm") == 0
+
+
+def test_quant_passes_are_registered():
+    import paddle_tpu.contrib.slim.quantization  # noqa: F401 — registers
+
+    for name in ("quantization_transform_pass", "quantization_freeze_pass",
+                 "convert_to_int8_pass", "conv_bn_fuse_pass"):
+        assert has_pass(name), name

@@ -1,0 +1,109 @@
+"""contrib/slim prune+distill scaffolding and the legacy ParallelExecutor
+wrapper (VERDICT round-2 missing items 7 & 8)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib import slim
+
+
+def test_ratio_pruner_masks():
+    v = np.array([[0.1, -0.9], [0.5, -0.05]], "float32")
+    mask = slim.RatioPruner({"*": 0.5}).prune(v, name="w")
+    assert mask.sum() == 2  # keep top-50% by |w|
+    assert mask[0, 1] == 1 and mask[1, 0] == 1
+    t = slim.MagnitudePruner(0.4).prune(v)
+    np.testing.assert_array_equal(t, (np.abs(v) >= 0.4).astype("float32"))
+
+
+def test_prune_strategy_in_compressor(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8])
+        y = fluid.layers.fc(x, size=4, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square(y))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    w_name = main.all_parameters()[0].name
+
+    feeds = [{"x": rng.randn(4, 8).astype("float32")} for _ in range(3)]
+    compressor = slim.build_compressor(
+        data_reader=lambda: iter(feeds), epoch=2, program_exe=exe,
+        strategies=[slim.PruneStrategy(slim.RatioPruner({"*": 0.25}),
+                                       start_epoch=0, end_epoch=10)])
+    ctx = compressor.apply(main)
+    assert ctx.epoch_id == 1 and ctx.batch_id == 3
+    w = np.asarray(fluid.global_scope().find_var(w_name))
+    sparsity = (w == 0).mean()
+    assert sparsity >= 0.70, "RatioPruner(0.25) should zero ~75%% (got %.2f)" % sparsity
+
+
+def test_distill_losses_build_and_match_numpy(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        t = fluid.layers.data("t", shape=[6])
+        s = fluid.layers.data("s", shape=[6])
+        soft = slim.distillation.soft_label_loss(t, s, temperature=2.0)
+        l2 = slim.distillation.l2_distill_loss(t, s)
+        ta = fluid.layers.data("ta", shape=[3, 4, 4])
+        tb = fluid.layers.data("tb", shape=[5, 4, 4])
+        sa = fluid.layers.data("sa", shape=[3, 4, 4])
+        sb = fluid.layers.data("sb", shape=[5, 4, 4])
+        fsp = slim.distillation.fsp_loss(ta, tb, sa, sb)
+    n = 3
+    tv = rng.randn(n, 6).astype("float32")
+    sv = rng.randn(n, 6).astype("float32")
+    fa = rng.randn(n, 3, 4, 4).astype("float32")
+    fb = rng.randn(n, 5, 4, 4).astype("float32")
+    ga = rng.randn(n, 3, 4, 4).astype("float32")
+    gb = rng.randn(n, 5, 4, 4).astype("float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    so, l2o, fo = exe.run(main, feed={"t": tv, "s": sv, "ta": fa, "tb": fb,
+                                      "sa": ga, "sb": gb},
+                          fetch_list=[soft, l2, fsp])
+
+    def softmax(z):
+        e = np.exp(z - z.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    T = 2.0
+    p = softmax(tv / T)
+    logq = np.log(softmax(sv / T))
+    np.testing.assert_allclose(
+        so, -(T * T) * (p * logq).sum(-1).mean(), rtol=1e-4)
+    np.testing.assert_allclose(l2o, ((tv - sv) ** 2).mean(), rtol=1e-5)
+
+    def fsp_mat(a, b):
+        n_, ca, h, w = a.shape
+        return np.einsum("nchw,ndhw->ncd", a, b) / (h * w)
+
+    np.testing.assert_allclose(
+        fo, ((fsp_mat(fa, fb) - fsp_mat(ga, gb)) ** 2).mean(), rtol=1e-4)
+
+
+def test_parallel_executor_legacy_wrapper(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        logits = fluid.layers.fc(h, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                main_program=main)
+    assert pe.device_count == 8  # virtual CPU mesh from conftest
+    first = last = None
+    for i in range(12):
+        xs = rng.randn(16, 8).astype("float32")
+        ys = (np.abs(xs).sum(1) % 4).astype("int64").reshape(-1, 1)
+        (lv,) = pe.run(fetch_list=[loss], feed={"x": xs, "label": ys})
+        first = first if first is not None else float(lv)
+        last = float(lv)
+    assert last < first
