@@ -74,7 +74,6 @@ class BuildStrategy(_StrategyBase):
         Customized = 2
 
     _INERT = {
-        "reduce_strategy": "GSPMD chooses collective patterns from shardings",
         "memory_optimize": "XLA buffer assignment + donation owns reuse",
         "enable_inplace": "XLA buffer donation owns in-place updates",
         "fuse_all_reduce_ops": "XLA fuses collectives itself",
@@ -188,14 +187,46 @@ class CompiledProgram:
         for p in builder.all_passes():
             if not p.has_attr("scope"):
                 p.set_attr("scope", scope if scope is not None else global_scope())
-        self._program = builder.apply_all(self._program)
-        # only after success: a failed pass must re-run next time, not be
-        # silently skipped
+        # transactional: passes may mutate the program in place, so run the
+        # pipeline on a clone — a mid-pipeline failure leaves the original
+        # untouched and the retry starts from scratch instead of
+        # double-applying the passes that had already run
+        work = self._program.clone()
+        self._program = builder.apply_all(work)
         self._passes_applied = True
+
+    # -- ZeRO-1 (ReduceStrategy.Reduce) ---------------------------------------
+    def _apply_reduce_strategy(self, mesh):
+        """``BuildStrategy.reduce_strategy == Reduce`` — the TPU-idiomatic
+        reading of the reference's Reduce mode (details/build_strategy.h:35 +
+        reduce_op_handle): instead of placing each param's *update* on one
+        device, shard every per-param optimizer accumulator over the ``data``
+        axis (ZeRO-1). GSPMD then partitions the optimizer update math and
+        all_gathers the fresh params; per-device optimizer-state memory drops
+        by ~the data-axis size. Applied once, before the first compile."""
+        if getattr(self, "_reduce_applied", False) or mesh is None:
+            return
+        self._reduce_applied = True
+        bs = self._build_strategy
+        if bs is None or bs.reduce_strategy != BuildStrategy.ReduceStrategy.Reduce:
+            return
+        if "data" not in mesh.axis_names:
+            return
+        ndata = mesh.shape["data"]
+        for v in self._program.list_vars():
+            if not getattr(v, "is_optimizer_state", False):
+                continue
+            if getattr(v, "sharding", None) is not None:
+                continue  # user/model-parallel annotation wins
+            shape = tuple(v.shape or ())
+            if not shape or shape[0] % ndata != 0 or shape[0] < ndata:
+                continue  # scalars (beta_pow etc.) stay replicated
+            v.sharding = ("data",) + (None,) * (len(shape) - 1)
 
     # -- execution (called from Executor.run) ---------------------------------
     def _run(self, executor, feed, fetch_list, scope, return_numpy):
         self._apply_build_passes(scope)
+        self._apply_reduce_strategy(self._mesh())
         accum = 1
         if self._build_strategy is not None:
             accum = getattr(self._build_strategy, "gradient_accumulation_steps", 1)

@@ -37,13 +37,17 @@ class QuantizationTransformPass(Pass):
             self.set_attr("place", place)
 
     def apply(self, program, startup_program=None):  # reference signature
-        # always overwrite: a stale startup from a previous apply() would
-        # receive this program's scale-initializer ops
-        self.set_attr("startup_program", startup_program)
+        if startup_program is not None:
+            # explicit arg wins; a user-set attr (the only channel available
+            # through PassBuilder.apply_all, which calls apply(program) bare)
+            # must survive an argless call
+            self.set_attr("startup_program", startup_program)
         return super().apply(program)
 
     def apply_impl(self, program):
-        return self._t.training_transpile(program, self.attr("startup_program"))
+        startup = (self.attr("startup_program")
+                   if self.has_attr("startup_program") else None)
+        return self._t.training_transpile(program, startup)
 
 
 @register_pass("quantization_freeze_pass")

@@ -25,13 +25,17 @@ import numpy as np
 
 from . import ops as _ops  # noqa: F401 — registers all op impls
 from .core.dtypes import to_jnp_dtype
-from .core.framework import Program, Variable, default_main_program, grad_var_name
+from .core.framework import (Program, Variable, default_main_program,
+                             grad_var_name, in_test_mode)
+from .flags import flags as _flags
 from .core.interpreter import run_block_ops
 from .core.place import Place, get_device
 from .core.registry import OpContext, get_op_impl
 from .core.scope import Scope, global_scope
 
 __all__ = ["Executor", "TraceContext"]
+
+_UserCompiledProgram = None  # lazily bound CompiledProgram class (import cycle)
 
 
 class TraceContext:
@@ -111,6 +115,7 @@ class _CompiledStep:
             }
 
         seed_const = program.random_seed or 0
+        self._out_state_sh = None  # set below when jit+mesh; guards jit=False
 
         def step(state, feeds, step_idx):
             # key derivation is part of the compiled step (fused, zero host
@@ -250,7 +255,15 @@ class _CompiledStep:
 
             new_state = {}
             for n in self.state_names:
-                new_state[n] = env.get(n, state.get(n))
+                val = env.get(n, state.get(n))
+                if (self._out_state_sh is not None and val is not None
+                        and hasattr(val, "dtype")):
+                    # pin output layout: params replicated, annotated vars (TP
+                    # params, ZeRO-1 optimizer shards) sharded — donation holds
+                    # and ZeRO-1 accumulators never silently gather
+                    val = jax.lax.with_sharding_constraint(
+                        val, self._out_state_sh[n])
+                new_state[n] = val
             fetches = [env[f] for f in self.fetch_names]
             return new_state, fetches
 
@@ -261,7 +274,20 @@ class _CompiledStep:
             batch_spec = P("data") if "data" in mesh.axis_names else P()
             feed_sh = {n: NamedSharding(mesh, batch_spec) for n in feed_names}
             # State shardings come from the arrays themselves (the executor
-            # device_puts them per Variable.sharding annotations).
+            # device_puts them per Variable.sharding annotations). Output state
+            # is pinned to the same layout — params replicated, annotated vars
+            # (TP params, ZeRO-1 optimizer shards) sharded — so buffer
+            # donation holds and ZeRO-1 accumulators never silently gather.
+            out_state_sh = {}
+            for n in state_names:
+                v = program.global_block._find_var_recursive(n)
+                spec = getattr(v, "sharding", None) if v is not None else None
+                if spec is not None and all(
+                        a is None or a in mesh.axis_names for a in spec):
+                    out_state_sh[n] = NamedSharding(mesh, P(*spec))
+                else:
+                    out_state_sh[n] = repl
+            self._out_state_sh = out_state_sh
             self.fn = jax.jit(
                 step,
                 in_shardings=(None, feed_sh, repl),
@@ -283,6 +309,10 @@ class Executor:
         self.place = place
         self._cache: Dict[tuple, _CompiledStep] = {}
         self._step_counters: Dict[int, int] = {}
+        # (id(program), version) -> sorted persistable names; recomputed only
+        # when the program mutates (version bump). Walking every program var
+        # per run() was the single largest host cost per step.
+        self._pnames_cache: Dict[tuple, Tuple[str, ...]] = {}
 
     def close(self):
         """Parity with executor.py:388 (pserver notify) — nothing to release."""
@@ -334,8 +364,11 @@ class Executor:
         return_numpy: bool = True,
         use_program_cache: bool = True,
     ):
-        from .compiler import CompiledProgram as _UserCompiledProgram
+        global _UserCompiledProgram
+        if _UserCompiledProgram is None:
+            from .compiler import CompiledProgram as _cp
 
+            _UserCompiledProgram = _cp
         if isinstance(program, _UserCompiledProgram):
             return program._run(self, feed, fetch_list, scope, return_numpy)
 
@@ -387,12 +420,22 @@ class Executor:
             feeds[name] = arr
             feed_sig.append((name, arr.shape, str(arr.dtype)))
 
-        state_names = self._persistable_names(program, scope)
-        # state vars that actually exist (startup creates them on first run)
-        state = self._gather_state(program, scope, state_names)
-        avail_state_names = tuple(sorted(state))
-
-        from .core.framework import in_test_mode
+        pkey = (id(program), program._version)
+        state_names = self._pnames_cache.get(pkey)
+        if state_names is None:
+            state_names = self._persistable_names(program, scope)
+            self._pnames_cache[pkey] = state_names
+        # state vars that actually exist (startup creates them on first run);
+        # iteration follows the pre-sorted state_names so no per-step re-sort
+        state = {}
+        svars = scope.vars
+        for n in state_names:
+            v = svars.get(n)
+            if v is None and scope.parent is not None:
+                v = scope.find_var(n)
+            if v is not None:
+                state[n] = v
+        avail_state_names = tuple(state)
 
         is_test = in_test_mode()
         is_training_or_has_feed = bool(feeds) or bool(fetch_names)
@@ -447,10 +490,14 @@ class Executor:
         else:
             dev = get_device(self.place)
             if dev is not None and feeds:
-                feeds = {k: jax.device_put(v, dev) for k, v in feeds.items()}
+                # jax.Arrays already on the right device skip the device_put —
+                # re-placing them every step costs real host time. Arrays
+                # committed elsewhere (e.g. fetched from a CPU executor) still
+                # get moved like before.
+                feeds = {k: v if isinstance(v, jax.Array) and dev in v.devices()
+                         else jax.device_put(v, dev)
+                         for k, v in feeds.items()}
         new_state, fetches = compiled(state, feeds, rng_key)
-
-        from .flags import flags as _flags
 
         if _flags.benchmark:
             # per-step device sync (reference: FLAGS_benchmark operator.cc:942)

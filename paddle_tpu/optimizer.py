@@ -118,6 +118,9 @@ class Optimizer:
         var = helper.create_or_get_global_variable(
             shape, dtype, acc_name, persistable=True,
             initializer=init_mod.Constant(float(fill_value)))
+        # marks the var as per-param optimizer state so BuildStrategy's
+        # ReduceStrategy.Reduce (ZeRO-1) can shard it over the data axis
+        var.is_optimizer_state = True
         self._accumulators.setdefault(name, {})[param.name] = var
         return var
 

@@ -72,10 +72,16 @@ class ConvBNFusePass(Pass):
                     i += 1
                     continue
                 # the add must be a per-channel BIAS, not a residual/shortcut
-                # add: Y is a 1-D var with a value in the scope
+                # or spatial-broadcast add: Y is a 1-D scope-resident var of
+                # length C, broadcast on the channel axis (axis=1 for NCHW)
                 y_var = block._find_var_recursive(bias_op.inputs["Y"][0])
+                w_var = block._find_var_recursive(conv.inputs["Filter"][0])
+                out_c = (w_var.shape[0] if w_var is not None
+                         and w_var.shape else None)
                 if (y_var is None or y_var.shape is None
                         or len(y_var.shape) != 1
+                        or y_var.shape[0] != out_c
+                        or bias_op.attrs.get("axis", -1) != 1
                         or scope.find_var(bias_op.inputs["Y"][0]) is None):
                     i += 1
                     continue

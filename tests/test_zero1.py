@@ -1,0 +1,81 @@
+"""ZeRO-1 (BuildStrategy.ReduceStrategy.Reduce) on the 8-device CPU mesh.
+
+The TPU-idiomatic reading of the reference's Reduce mode
+(details/build_strategy.h:35 + details/reduce_op_handle.cc): optimizer
+accumulators shard over the data axis, GSPMD partitions the update math and
+all_gathers fresh params. Must match AllReduce-mode losses exactly and cut
+per-device optimizer-state memory by ~the data-axis size.
+"""
+
+import jax
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _build(seed=1234):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[16])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=64, act="relu")
+        logits = fluid.layers.fc(h, size=4)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    return main, startup, loss
+
+
+def _run(rng_seed, reduce_mode, steps=8, batch=16):
+    rng = np.random.RandomState(rng_seed)
+    xs = rng.randn(steps * batch, 16).astype("float32")
+    ys = rng.randint(0, 4, (steps * batch, 1)).astype("int64")
+    with fluid.unique_name.guard():
+        with fluid.scope_guard(fluid.Scope()):
+            main, startup, loss = _build()
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            bs = fluid.BuildStrategy()
+            if reduce_mode:
+                bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+            prog = fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=loss.name, build_strategy=bs)
+            losses = []
+            for i in range(0, len(xs), batch):
+                l, = exe.run(prog, feed={"x": xs[i:i + batch], "y": ys[i:i + batch]},
+                             fetch_list=[loss])
+                losses.append(float(l))
+            scope = fluid.global_scope()
+            moments = {n: scope.find_var(n) for n in scope.local_var_names()
+                       if "_adam_moment" in n}
+            return losses, moments
+
+
+def test_zero1_loss_parity():
+    assert len(jax.devices()) == 8
+    base, _ = _run(7, reduce_mode=False)
+    zero1, moments = _run(7, reduce_mode=True)
+    np.testing.assert_allclose(base, zero1, rtol=1e-4, atol=1e-5)
+    assert zero1[-1] < zero1[0]
+
+
+def test_zero1_optimizer_state_actually_sharded():
+    _, moments = _run(7, reduce_mode=True)
+    # fc weights are [16,64]/[64,4]: dim0 divides 8 -> moments shard 8-way
+    sharded = {n: v for n, v in moments.items()
+               if np.asarray(v).ndim == 2}
+    assert sharded, "expected 2-D adam moments in scope"
+    for n, v in sharded.items():
+        assert len(v.sharding.device_set) == 8, n
+        shard = v.addressable_shards[0].data
+        assert shard.shape[0] * 8 == v.shape[0], (n, shard.shape, v.shape)
+
+
+def test_allreduce_mode_keeps_state_replicated():
+    _, moments = _run(7, reduce_mode=False)
+    for n, v in moments.items():
+        if np.asarray(v).ndim != 2:
+            continue
+        # replicated: every device holds the full array
+        assert v.addressable_shards[0].data.shape == v.shape, n
