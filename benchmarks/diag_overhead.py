@@ -1,0 +1,103 @@
+"""Diagnose the framework-vs-raw-JAX gap at the XLA level.
+
+Lowers both the paddle_tpu transformer train step and bench.py's raw-JAX
+twin at identical shapes, compiles, and prints XLA cost analysis (flops,
+bytes accessed) plus a measured per-step time for each. The delta in flops
+or bytes names the part of the traced program that raw JAX doesn't have.
+
+Usage: python benchmarks/diag_overhead.py  (on axon TPU)
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def fmt(ca):
+    return {k: ca.get(k) for k in ("flops", "bytes accessed", "transcendentals")
+            if k in ca}
+
+
+def main():
+    sys.path.insert(0, ".")
+    import jax
+
+    import bench
+
+    batch, seq, vocab = 64, 256, 30000
+
+    # -- framework step ------------------------------------------------------
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer as tfm
+
+    with fluid.unique_name.guard():
+        with fluid.scope_guard(fluid.Scope()):
+            main_prog, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main_prog, startup):
+                src = fluid.layers.data("src", shape=[seq], dtype="int64")
+                trg = fluid.layers.data("trg", shape=[seq], dtype="int64")
+                lbl = fluid.layers.data("lbl", shape=[seq, 1], dtype="int64")
+                smask = fluid.layers.data("smask", shape=[seq], dtype="float32")
+                tmask = fluid.layers.data("tmask", shape=[seq], dtype="float32")
+                logits, loss = tfm.transformer_base(
+                    src, trg, lbl, smask, tmask, src_vocab_size=vocab,
+                    trg_vocab_size=vocab, max_length=seq, dropout_rate=0.1)
+                opt = fluid.optimizer.Adam(learning_rate=1e-4)
+                opt = fluid.amp.decorate(opt)
+                opt.minimize(loss)
+
+            exe = fluid.Executor(fluid.TPUPlace(0))
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            feed = bench._device_feed({
+                "src": rng.randint(2, vocab, (batch, seq)).astype("int64"),
+                "trg": rng.randint(2, vocab, (batch, seq)).astype("int64"),
+                "lbl": rng.randint(2, vocab, (batch, seq, 1)).astype("int64"),
+                "smask": np.ones((batch, seq), "float32"),
+                "tmask": np.ones((batch, seq), "float32"),
+            })
+            # trigger compile + grab the cached step
+            exe.run(main_prog, feed=feed, fetch_list=[loss], return_numpy=False)
+            compiled = next(c for c in exe._cache.values() if c.fetch_names)
+            scope = fluid.global_scope()
+            state = {n: scope.vars[n] for n in compiled.state_names
+                     if n in scope.vars}
+            comp = compiled.fn.lower(state, feed, np.uint32(0)).compile()
+            ca = comp.cost_analysis()
+            print("paddle_tpu :", fmt(ca))
+            print("paddle_tpu mem:", comp.memory_analysis())
+            with open("/tmp/hlo_paddle.txt", "w") as f:
+                f.write(comp.as_text())
+
+            def fw_step():
+                lv, = exe.run(main_prog, feed=feed, fetch_list=[loss],
+                              return_numpy=False)
+                return lv
+
+            eps, sps = bench._timeit(fw_step, batch)
+            print("paddle_tpu : %.1f ex/s  %.2f ms/step" % (eps, 1e3 / sps))
+
+    # -- raw JAX twin --------------------------------------------------------
+    # rebuild raw bench pieces with lowering access
+    import functools
+
+    import jax.numpy as jnp  # noqa
+
+    diag = {}
+    eps_raw, sps_raw = bench.bench_raw_jax_transformer(batch, seq, vocab,
+                                                       _diag=diag)
+    if "lowered" in diag:
+        rcomp = diag["lowered"].compile()
+        print("raw jax    :", fmt(rcomp.cost_analysis()))
+        print("raw jax mem:", rcomp.memory_analysis())
+        with open("/tmp/hlo_raw.txt", "w") as f:
+            f.write(rcomp.as_text())
+    print("raw jax    : %.1f ex/s  %.2f ms/step" % (eps_raw, 1e3 / sps_raw))
+    print("overhead   : %.4f" % (eps_raw / eps))
+
+
+if __name__ == "__main__":
+    main()

@@ -213,10 +213,15 @@ def conv2d(
     use_cudnn: bool = True,
     act: Optional[str] = None,
     name: Optional[str] = None,
+    data_format: str = "NCHW",
 ):
-    """2-D convolution, NCHW, OIHW weights (reference: layers/nn.py conv2d)."""
+    """2-D convolution, OIHW weights (reference: layers/nn.py conv2d).
+
+    data_format NHWC runs channels-last — the TPU-native layout (channels on
+    the 128-lane minor dim); weights stay OIHW so checkpoints are portable.
+    """
     helper = LayerHelper("conv2d", bias_attr=bias_attr, act=act, name=name)
-    num_channels = input.shape[1]
+    num_channels = input.shape[-1] if data_format == "NHWC" else input.shape[1]
     filter_size = _pair(filter_size)
     stride = _pair(stride)
     padding = _pair(padding)
@@ -246,6 +251,7 @@ def conv2d(
             "dilations": list(dilation),
             "groups": groups,
             "use_cudnn": use_cudnn,
+            "data_format": data_format,
         },
     )
     if bias_attr is False:
@@ -259,7 +265,7 @@ def conv2d(
             "elementwise_add",
             inputs={"X": pre_bias, "Y": bias},
             outputs={"Out": pre_act},
-            attrs={"axis": 1},
+            attrs={"axis": 3 if data_format == "NHWC" else 1},
         )
     return helper.append_activation(pre_act)
 
@@ -335,7 +341,8 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None, pad
 
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
-           global_pooling=False, use_cudnn=True, ceil_mode=False, exclusive=True, name=None):
+           global_pooling=False, use_cudnn=True, ceil_mode=False, exclusive=True,
+           name=None, data_format="NCHW"):
     helper = LayerHelper("pool2d", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op(
@@ -350,6 +357,7 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
             "global_pooling": global_pooling,
             "ceil_mode": ceil_mode,
             "exclusive": exclusive,
+            "data_format": data_format,
         },
     )
     return out

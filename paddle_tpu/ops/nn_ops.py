@@ -11,6 +11,8 @@ relayout internally for the TPU's preferred tiling.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -78,17 +80,22 @@ def softmax_with_cross_entropy_op(ctx: OpContext):
     soft_label = ctx.attr("soft_label", False)
     smooth = float(ctx.attr("label_smoothing", 0.0) or 0.0)
     out_dtype = logits.dtype
-    if (not soft_label and not smooth
+    if (not soft_label
+            and (not smooth or logits.shape[-1] % 128 == 0)
             and ctx.attr("ignore_index", -100) == -100
             and _fused_xent_ok(logits)):
         # Pallas fused path (pallas_kernels/softmax_xent.py): forward writes
-        # only O(N) outputs; backward computes softmax-onehot on the fly.
+        # only O(N) outputs; backward computes softmax-onehot (with the
+        # closed-form label-smoothing term) on the fly. Smoothed + ragged
+        # vocab stays on the composed path: measured on v5e (16384×30000
+        # bf16 fwd+bwd) the pad copy makes pallas 92.8ms vs XLA 82.7ms —
+        # XLA fuses the single-pass smoothing formula just as well.
         from .pallas_kernels import fused_softmax_xent
 
         v = logits.shape[-1]
         lead = logits.shape[:-1]
         lbl2d = label.reshape(-1, 1)
-        loss = fused_softmax_xent(logits.reshape(-1, v), lbl2d)
+        loss = fused_softmax_xent(logits.reshape(-1, v), lbl2d, False, smooth)
         ctx.set_output("Loss", loss.reshape(*lead, 1).astype(out_dtype))
         if ctx.has_output("Softmax"):
             # derived lazily (reference grad kernel also treats Softmax as a
@@ -201,6 +208,65 @@ def margin_rank_loss_op(ctx: OpContext):
 # -- normalization ------------------------------------------------------------
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train(x, scale, bias, reduce_axes, eps):
+    y, _ = _bn_train_fwd(x, scale, bias, reduce_axes, eps)
+    return y
+
+
+def _bn_stats(x, reduce_axes):
+    # f32 ACCUMULATION directly off the bf16 input — never materializes an
+    # f32 copy of the activation (jnp.mean(x.astype(f32)) does, and its VJP
+    # then drags f32 [N,C,H,W] cotangents through the whole backward)
+    n = 1
+    for a in reduce_axes:
+        n *= x.shape[a]
+    mean = jnp.sum(x, axis=reduce_axes, dtype=jnp.float32) / n
+    var = jnp.sum(jnp.square(x.astype(jnp.float32)), axis=reduce_axes,
+                  dtype=jnp.float32) / n - jnp.square(mean)
+    return mean, var, n
+
+
+def _bn_train_fwd(x, scale, bias, reduce_axes, eps):
+    mean, var, _ = _bn_stats(x, reduce_axes)
+    inv = jax.lax.rsqrt(var + eps)
+    bshape = [1] * x.ndim
+    ch_axis = [a for a in range(x.ndim) if a not in reduce_axes][0]
+    bshape[ch_axis] = x.shape[ch_axis]
+    xhat = (x - mean.astype(x.dtype).reshape(bshape)) * inv.astype(x.dtype).reshape(bshape)
+    y = (xhat * scale.astype(x.dtype).reshape(bshape)
+         + bias.astype(x.dtype).reshape(bshape))
+    return y, (x, scale, mean, inv)
+
+
+def _bn_train_bwd(reduce_axes, eps, res, dy):
+    # classic fused BN backward (reference: batch_norm_op.cc grad kernel):
+    # dx = (γ·inv/N)·(N·dy − Σdy − x̂·Σ(dy·x̂)) — two f32-accumulated
+    # reductions and one elementwise pass, all in x.dtype
+    x, scale, mean, inv = res
+    ch_axis = [a for a in range(x.ndim) if a not in reduce_axes][0]
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x.shape[ch_axis]
+    n = 1
+    for a in reduce_axes:
+        n *= x.shape[a]
+    xhat = (x - mean.astype(x.dtype).reshape(bshape)) * inv.astype(x.dtype).reshape(bshape)
+    dy_sum = jnp.sum(dy, axis=reduce_axes, dtype=jnp.float32)
+    dyxhat_sum = jnp.sum((dy * xhat).astype(jnp.float32), axis=reduce_axes,
+                         dtype=jnp.float32)
+    dscale = dyxhat_sum
+    dbias = dy_sum
+    coef = (scale.astype(jnp.float32) * inv / n).astype(x.dtype)
+    dx = coef.reshape(bshape) * (
+        n * dy
+        - dy_sum.astype(x.dtype).reshape(bshape)
+        - xhat * dyxhat_sum.astype(x.dtype).reshape(bshape))
+    return dx, dscale.astype(scale.dtype), dbias.astype(scale.dtype)
+
+
+_bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
+
+
 @register_op("batch_norm")
 def batch_norm_op(ctx: OpContext):
     """Reference: operators/batch_norm_op.cc. NCHW/NHWC via data_layout attr.
@@ -223,23 +289,28 @@ def batch_norm_op(ctx: OpContext):
     bshape[ch_axis] = x.shape[ch_axis]
 
     cdt = jnp.float32
-    xf = x.astype(cdt)
     if use_global:
         use_mean, use_var = mean.astype(cdt), var.astype(cdt)
         ctx.set_output("MeanOut", mean)
         ctx.set_output("VarianceOut", var)
+        inv = jax.lax.rsqrt(use_var + eps).astype(x.dtype)
+        y = (x - use_mean.astype(x.dtype).reshape(bshape)) * inv.reshape(bshape)
+        y = (y * scale.astype(x.dtype).reshape(bshape)
+             + bias.astype(x.dtype).reshape(bshape))
+        ctx.set_output("Y", y)
     else:
-        bmean = jnp.mean(xf, axis=reduce_axes)
-        bvar = jnp.mean(jnp.square(xf), axis=reduce_axes) - jnp.square(bmean)
-        use_mean, use_var = bmean, bvar
+        # custom-vjp fused path: f32-accumulated stats straight off the bf16
+        # input and the closed-form BN backward — autodiff through the stats
+        # otherwise drags f32 [N,C,H,W] cotangents through the graph
+        # (measured ~30% of ResNet-50 step HBM traffic)
+        bmean, bvar, _ = _bn_stats(x, reduce_axes)
+        bmean = jax.lax.stop_gradient(bmean)
+        bvar = jax.lax.stop_gradient(bvar)
         ctx.set_output("MeanOut", (momentum * mean.astype(cdt) + (1 - momentum) * bmean).astype(mean.dtype))
         ctx.set_output("VarianceOut", (momentum * var.astype(cdt) + (1 - momentum) * bvar).astype(var.dtype))
         ctx.set_output("SavedMean", bmean.astype(mean.dtype))
         ctx.set_output("SavedVariance", bvar.astype(var.dtype))
-    inv = jax.lax.rsqrt(use_var + eps)
-    y = (xf - use_mean.reshape(bshape)) * inv.reshape(bshape)
-    y = y * scale.astype(cdt).reshape(bshape) + bias.astype(cdt).reshape(bshape)
-    ctx.set_output("Y", y.astype(x.dtype))
+        ctx.set_output("Y", _bn_train(x, scale, bias, reduce_axes, eps))
 
 
 @register_op("layer_norm")
@@ -249,18 +320,21 @@ def layer_norm_op(ctx: OpContext):
     axis = ctx.attr("begin_norm_axis", 1)
     eps = ctx.attr("epsilon", 1e-5)
     axes = tuple(range(axis, x.ndim))
-    cdt = jnp.float32
-    xf = x.astype(cdt)
+    # stats in f32 (catastrophic cancellation in bf16 means), but the
+    # normalize itself in x.dtype — the [B,S,D]-sized intermediates the VJP
+    # saves then stay bf16 under AMP instead of silently doubling HBM traffic
+    xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=axes, keepdims=True)
     var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
-    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    inv_std = jax.lax.rsqrt(var + eps)
+    y = (x - mean.astype(x.dtype)) * inv_std.astype(x.dtype)
     scale, bias = ctx.input("Scale"), ctx.input("Bias")
     norm_shape = x.shape[axis:]
     if scale is not None:
-        y = y * scale.astype(cdt).reshape(norm_shape)
+        y = y * scale.astype(x.dtype).reshape(norm_shape)
     if bias is not None:
-        y = y + bias.astype(cdt).reshape(norm_shape)
-    ctx.set_output("Y", y.astype(x.dtype))
+        y = y + bias.astype(x.dtype).reshape(norm_shape)
+    ctx.set_output("Y", y)
     ctx.set_output("Mean", mean.reshape(x.shape[:axis]).reshape(-1))
     ctx.set_output("Variance", var.reshape(x.shape[:axis]).reshape(-1))
 
@@ -349,14 +423,17 @@ def affine_channel_op(ctx: OpContext):
 
 def _conv_nd(ctx: OpContext, nd: int, transpose: bool = False):
     x = ctx.input("Input")
-    w = ctx.input("Filter")  # OIHW
+    w = ctx.input("Filter")  # OIHW (layout-independent param storage)
     strides = tuple(ctx.attr("strides", [1] * nd))
     paddings = ctx.attr("paddings", [0] * nd)
     dilations = tuple(ctx.attr("dilations", [1] * nd))
     groups = ctx.attr("groups", 1) or 1
     pad = [(p, p) for p in paddings]
     spatial = "DHW"[-nd:]
-    lhs_spec = "NC" + spatial
+    # NHWC is the TPU-preferred activation layout (channels on the 128-lane
+    # minor dim); params stay OIHW so checkpoints are layout-portable
+    fmt = ctx.attr("data_format", "NCHW")
+    lhs_spec = ("N" + spatial + "C") if fmt in ("NHWC", "NDHWC") else "NC" + spatial
     rhs_spec = "OI" + spatial
     dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, (lhs_spec, rhs_spec, lhs_spec))
     if not transpose:
@@ -418,10 +495,12 @@ def _pool_nd(ctx: OpContext, nd: int):
     ksize = list(ctx.attr("ksize", [1] * nd))
     strides = list(ctx.attr("strides", [1] * nd))
     paddings = list(ctx.attr("paddings", [0] * nd))
+    nhwc = ctx.attr("data_format", "NCHW") in ("NHWC", "NDHWC")
+    sp0 = 1 if nhwc else 2  # first spatial axis
     red = jnp.max if ptype == "max" else jnp.mean
     if ctx.attr("global_pooling", False) or (
             ctx.attr("adaptive", False) and all(k == 1 for k in ksize)):
-        axes = tuple(range(2, 2 + nd))
+        axes = tuple(range(sp0, sp0 + nd))
         ctx.set_output("Out", red(x, axis=axes, keepdims=True))
         return
     if ctx.attr("adaptive", False):
@@ -432,7 +511,7 @@ def _pool_nd(ctx: OpContext, nd: int):
         # per-output-slice loop (output sizes are small, e.g. 7).
         out = x
         for d, osize in enumerate(int(k) for k in ksize):
-            axis = 2 + d
+            axis = sp0 + d
             insize = out.shape[axis]
             if insize % osize == 0:
                 k = insize // osize
@@ -448,9 +527,14 @@ def _pool_nd(ctx: OpContext, nd: int):
                 out = jnp.stack(pieces, axis=axis)
         ctx.set_output("Out", out)
         return
-    window = (1, 1) + tuple(ksize)
-    stride = (1, 1) + tuple(strides)
-    pad = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
+    if nhwc:
+        window = (1,) + tuple(ksize) + (1,)
+        stride = (1,) + tuple(strides) + (1,)
+        pad = ((0, 0),) + tuple((p, p) for p in paddings) + ((0, 0),)
+    else:
+        window = (1, 1) + tuple(ksize)
+        stride = (1, 1) + tuple(strides)
+        pad = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         out = jax.lax.reduce_window(x, init, jax.lax.max, window, stride, pad)

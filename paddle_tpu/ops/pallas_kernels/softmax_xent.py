@@ -36,7 +36,8 @@ _BV = 2048  # vocab-tile lanes (multiple of 128)
 _NEG = -1e30
 
 
-def _fwd_kernel(labels_ref, logits_ref, loss_ref, lse_ref, m_ref, s_ref, z_ref):
+def _fwd_kernel(labels_ref, logits_ref, loss_ref, lse_ref, m_ref, s_ref, z_ref,
+                *, smooth=0.0, v_true=0):
     j = pl.program_id(1)
     nv = pl.num_programs(1)
 
@@ -53,12 +54,20 @@ def _fwd_kernel(labels_ref, logits_ref, loss_ref, lse_ref, m_ref, s_ref, z_ref):
     s_ref[:] = s_ref[:] * corr + jnp.sum(jnp.exp(tile - m_new), axis=1, keepdims=True)
     m_ref[:] = m_new
 
-    # gather the label logit if it falls inside this vocab tile
+    # gather the label logit if it falls inside this vocab tile; with label
+    # smoothing, fold in this tile's share of (ε/V)·Σx in the same pass
+    # (loss = lse - (1-ε)·x_label - (ε/V)·Σx), masking the -1e30 pad columns
     lab = labels_ref[:].astype(jnp.int32)                # [BN, 1]
     col0 = j * tile.shape[1]
     cols = jax.lax.broadcasted_iota(jnp.int32, tile.shape, 1) + col0
     hit = cols == lab                                    # [BN, BV]
-    z_ref[:] = z_ref[:] + jnp.sum(jnp.where(hit, tile, 0.0), axis=1, keepdims=True)
+    zlab = jnp.sum(jnp.where(hit, tile, 0.0), axis=1, keepdims=True)
+    if smooth:
+        real = cols < v_true
+        zsum = jnp.sum(jnp.where(real, tile, 0.0), axis=1, keepdims=True)
+        z_ref[:] = z_ref[:] + (1.0 - smooth) * zlab + (smooth / v_true) * zsum
+    else:
+        z_ref[:] = z_ref[:] + zlab
 
     @pl.when(j == nv - 1)
     def _():
@@ -67,7 +76,8 @@ def _fwd_kernel(labels_ref, logits_ref, loss_ref, lse_ref, m_ref, s_ref, z_ref):
         loss_ref[:] = lse - z_ref[:]
 
 
-def _bwd_kernel(labels_ref, logits_ref, lse_ref, g_ref, dlogits_ref):
+def _bwd_kernel(labels_ref, logits_ref, lse_ref, g_ref, dlogits_ref,
+                *, smooth=0.0, v_true=0):
     j = pl.program_id(1)
     tile = logits_ref[:].astype(jnp.float32)
     p = jnp.exp(tile - lse_ref[:])                       # softmax probs
@@ -75,7 +85,12 @@ def _bwd_kernel(labels_ref, logits_ref, lse_ref, g_ref, dlogits_ref):
     col0 = j * tile.shape[1]
     cols = jax.lax.broadcasted_iota(jnp.int32, tile.shape, 1) + col0
     onehot = (cols == lab).astype(jnp.float32)
-    dlogits_ref[:] = (g_ref[:] * (p - onehot)).astype(dlogits_ref.dtype)
+    if smooth:
+        # d/dx[(1-ε)·nll + (ε/V)·Σ(-logp)] = p - (1-ε)·onehot - ε/V
+        d = p - (1.0 - smooth) * onehot - (smooth / v_true)
+    else:
+        d = p - onehot
+    dlogits_ref[:] = (g_ref[:] * d).astype(dlogits_ref.dtype)
 
 
 def softmax_xent_supported(n: int, v: int, dtype) -> bool:
@@ -101,19 +116,21 @@ def _pad(logits, labels):
     return logits, labels, bn, bv, n_pad, v_pad
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
-def fused_softmax_xent(logits, labels, interpret: bool = False):
-    """loss[N,1] = -log softmax(logits)[labels] with hard int labels [N,1]."""
-    loss, _ = _fwd(logits, labels, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def fused_softmax_xent(logits, labels, interpret: bool = False,
+                       smooth: float = 0.0):
+    """loss[N,1] = CE(softmax(logits), labels) with hard int labels [N,1];
+    ``smooth`` applies label smoothing in the same streamed pass."""
+    loss, _ = _fwd(logits, labels, interpret, smooth)
     return loss
 
 
-def _call_fwd(logits, labels, bn, bv, interpret):
+def _call_fwd(logits, labels, bn, bv, interpret, smooth, v_true):
     n, v = logits.shape
     grid = (n // bn, v // bv)
     acc = lambda: pltpu.VMEM((bn, 1), jnp.float32) if pltpu else None
     return pl.pallas_call(
-        _fwd_kernel,
+        functools.partial(_fwd_kernel, smooth=smooth, v_true=v_true),
         grid=grid,
         in_specs=[
             pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
@@ -132,7 +149,7 @@ def _call_fwd(logits, labels, bn, bv, interpret):
     )(labels, logits)
 
 
-def _fwd(logits, labels, interpret):
+def _fwd(logits, labels, interpret, smooth=0.0):
     if pltpu is None and not interpret:
         raise RuntimeError(
             "fused_softmax_xent: pallas TPU backend unavailable on this "
@@ -141,18 +158,18 @@ def _fwd(logits, labels, interpret):
     n, v = logits.shape
     labels = labels.reshape(n, 1)
     plog, plab, bn, bv, n_pad, v_pad = _pad(logits, labels)
-    loss, lse = _call_fwd(plog, plab, bn, bv, interpret)
+    loss, lse = _call_fwd(plog, plab, bn, bv, interpret, float(smooth), v)
     if n_pad:
         loss, lse = loss[:n], lse[:n]
     return loss, lse
 
 
-def _fused_fwd(logits, labels, interpret):
-    loss, lse = _fwd(logits, labels, interpret)
+def _fused_fwd(logits, labels, interpret, smooth):
+    loss, lse = _fwd(logits, labels, interpret, smooth)
     return loss, (logits, labels, lse)
 
 
-def _fused_bwd(interpret, res, g):
+def _fused_bwd(interpret, smooth, res, g):
     logits, labels, lse = res
     n, v = logits.shape
     labels = labels.reshape(n, 1)
@@ -164,7 +181,7 @@ def _fused_bwd(interpret, res, g):
     pn, pv = plog.shape
     grid = (pn // bn, pv // bv)
     dlogits = pl.pallas_call(
-        _bwd_kernel,
+        functools.partial(_bwd_kernel, smooth=float(smooth), v_true=v),
         grid=grid,
         in_specs=[
             pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),

@@ -1,11 +1,14 @@
 """Expert parallelism: Switch-style Mixture-of-Experts over a mesh axis.
 
 The reference has no MoE (SURVEY §3 marks EP absent); this implements the
-TPU-native design directly — the GShard/Switch dispatch formulation:
-top-1 routing → capacity-limited one-hot dispatch tensor → einsum
-dispatch/combine, with experts sharded over an ``expert`` mesh axis inside
-``shard_map`` and tokens exchanged by ``all_to_all`` over ICI. Everything is
-static-shape (capacity padding, dropped-token masking) and differentiable.
+TPU-native design directly — top-1 routing with **sort-based dispatch**
+(the MaxText/Praxis formulation): tokens are argsorted by their chosen
+expert and scattered into capacity-packed per-expert buffers, so dispatch
+memory is O(N·D + E·C·D) instead of the GShard one-hot formulation's
+O(N·E·C) dispatch tensor (which dominates at real expert counts). Experts
+are sharded over an ``expert`` mesh axis inside ``shard_map`` with the
+packed buffers exchanged over ICI. Everything is static-shape (capacity
+padding, dropped-token masking) and differentiable.
 """
 
 from __future__ import annotations
@@ -16,32 +19,12 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+try:  # jax >= 0.4.35 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 __all__ = ["switch_moe", "make_switch_ffn"]
-
-
-def _dispatch_tensors(gate_logits, capacity):
-    """gate_logits [N, E] → (dispatch [N, E, C] one-hot, combine [N, E, C],
-    aux_loss). Top-1 routing with per-expert capacity (Switch Transformer
-    semantics: overflow tokens are dropped from the expert but pass through
-    the residual path as zeros here)."""
-    n, e = gate_logits.shape
-    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
-    expert = jnp.argmax(probs, axis=-1)                 # [N]
-    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)
-    # position of each token within its expert's queue
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0     # [N, E], -1 elsewhere
-    pos_in_expert = jnp.sum(pos * onehot, axis=1)       # [N]
-    keep = pos_in_expert < capacity
-    gate = jnp.sum(probs * onehot, axis=1) * keep       # [N]
-    slot = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), capacity,
-                          dtype=jnp.float32)            # [N, C]
-    dispatch = onehot[:, :, None] * slot[:, None, :] * keep[:, None, None]
-    combine = dispatch * gate[:, None, None]
-    # load-balancing auxiliary loss (Switch eq. 4): E * Σ_e f_e · p_e
-    frac_tokens = jnp.mean(onehot, axis=0)
-    frac_probs = jnp.mean(probs, axis=0)
-    aux = e * jnp.sum(frac_tokens * frac_probs)
-    return dispatch, combine, aux
 
 
 def switch_moe(x, gate_w, expert_params, expert_fn: Callable, mesh: Mesh,
@@ -55,11 +38,11 @@ def switch_moe(x, gate_w, expert_params, expert_fn: Callable, mesh: Mesh,
       (each device holds its experts)
     - expert_fn(params_one_expert, tokens [C, D]) -> [C, D]
 
-    Returns (y [B, T, D], aux_loss). Differentiable; all_to_all moves only
-    the capacity-packed token buffers between experts.
+    Returns (y [B, T, D], aux_loss). Switch semantics: overflow tokens
+    beyond an expert's capacity are dropped (pass through as zeros).
+    Differentiable; only the capacity-packed [E, C, D] buffers move
+    between experts.
     """
-    from jax.experimental.shard_map import shard_map
-
     b, t, d = x.shape
     n = b * t
     e = gate_w.shape[-1]
@@ -69,21 +52,45 @@ def switch_moe(x, gate_w, expert_params, expert_fn: Callable, mesh: Mesh,
 
     flat = x.reshape(n, d)
     gate_logits = flat @ gate_w
-    dispatch, combine, aux = _dispatch_tensors(gate_logits, capacity)
-    # token buffers per expert: [E, C, D]
-    expert_in = jnp.einsum("nd,nec->ecd", flat.astype(jnp.float32), dispatch)
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                  # [N]
+    gate = jnp.max(probs, axis=-1)                       # prob of chosen expert
 
-    def shard_body(params, buf):
-        # buf arrives [E/n_shards, C, D] for THIS shard's experts
-        return jax.vmap(expert_fn)(params, buf)
+    # sort-based dispatch: group tokens by expert, position within group
+    order = jnp.argsort(expert)                          # stable
+    sorted_expert = expert[order]
+    counts = jnp.bincount(expert, length=e)              # [E]
+    starts = jnp.cumsum(counts) - counts
+    pos = jnp.arange(n) - starts[sorted_expert]          # rank inside expert
+    keep = pos < capacity
+    # dropped tokens target a dummy row that is sliced off (zero cotangent)
+    slot = jnp.where(keep, sorted_expert * capacity + pos, e * capacity)
+    vals = flat[order] * keep[:, None].astype(x.dtype)
+    buf = jnp.zeros((e * capacity + 1, d), x.dtype).at[slot].set(vals)
+    expert_in = buf[:-1].reshape(e, capacity, d)
 
-    expert_out = shard_map(
+    def shard_body(params, buf_):
+        # buf_ arrives [E/n_shards, C, D] for THIS shard's experts
+        return jax.vmap(expert_fn)(params, buf_)
+
+    expert_out = _shard_map(
         shard_body, mesh=mesh,
         in_specs=(jax.tree.map(lambda _: P(axis), expert_params), P(axis)),
-        out_specs=P(axis), check_rep=False,
-    )(expert_params, expert_in.astype(x.dtype))
+        out_specs=P(axis), check_vma=False,
+    )(expert_params, expert_in)
 
-    y = jnp.einsum("ecd,nec->nd", expert_out.astype(jnp.float32), combine)
+    # combine: gather each token's expert output, weight by its gate prob
+    out_flat = expert_out.reshape(e * capacity, d)
+    safe_slot = jnp.clip(slot, 0, e * capacity - 1)
+    gathered = out_flat[safe_slot] * keep[:, None].astype(x.dtype)
+    y_sorted = gathered * (gate[order].astype(x.dtype))[:, None]
+    inv = jnp.argsort(order)
+    y = y_sorted[inv]
+
+    # load-balancing auxiliary loss (Switch eq. 4): E * Σ_e f_e · p_e
+    frac_tokens = counts.astype(jnp.float32) / n
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
     return y.reshape(b, t, d).astype(x.dtype), aux.astype(x.dtype)
 
 

@@ -4,16 +4,23 @@ section-program pipeline; reimagined TPU-first).
 
 Design (the collective-pipelining recipe from the public scaling
 literature): stages are laid out along a ``pipe`` mesh axis; a GPipe
-schedule runs M microbatches through S stages in M+S-1 ticks inside a
-``lax.fori_loop``, rotating activations between neighbouring stages with
-``lax.ppermute`` over ICI. The whole schedule — including the bubble — is
-one compiled XLA computation, and the *backward* pipeline schedule falls
-out of JAX AD transposing the loop (ppermute transposes to the reverse
-rotation), so there is no hand-written 1F1B scheduler.
+schedule runs M microbatches through S stages in M+S-1 ticks, rotating
+activations between neighbouring stages with ``lax.ppermute`` over ICI.
+The tick loop is unrolled at trace time so the feed/collect permutes have
+static source/destination pairs. The whole schedule — including the
+bubble — is one compiled XLA computation, and the *backward* pipeline
+schedule falls out of JAX AD transposing the permutes, so there is no
+hand-written 1F1B scheduler.
 
-Stage parameters live stacked on a leading [S, ...] axis sharded over
-``pipe`` — each device holds only its own stage's weights (the memory win
-that motivates pipeline parallelism).
+Memory layout (the point of pipeline parallelism):
+  - stage params: stacked [S, ...], sharded over ``pipe`` — each device
+    holds only its own stage's weights;
+  - microbatches [M, mb, ...]: sharded over ``pipe`` on the M axis — each
+    device stores M/S microbatches, feeding stage 0 one microbatch per
+    tick via a single-pair ppermute (an mb-sized ICI hop);
+  - outputs: collected back to the same [M/S per device] layout; at no
+    tick does any device hold more than its input slab + one in-flight
+    microbatch activation.
 """
 
 from __future__ import annotations
@@ -23,6 +30,11 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.4.35 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
 
 __all__ = ["gpipe", "pipeline_step", "stack_stage_params"]
 
@@ -38,54 +50,70 @@ def gpipe(stage_fn: Callable, mesh: Mesh, axis: str = "pipe"):
     - ``stage_fn(params, x) -> y`` — one stage; activations must keep one
       shape across stages (standard for transformer blocks).
     - ``stacked_params``: leading [S] axis (see stack_stage_params).
-    - ``microbatches``: [M, mb, ...] — the caller's batch split into M
-      microbatches.
+    - ``microbatches``: [M, mb, ...] with M divisible by the pipe size —
+      sharded over the pipe axis (a replicated array is resharded by GSPMD
+      on entry).
 
-    Returns outputs [M, mb, ...], replicated (the last stage's results are
-    broadcast back so the loss is computable everywhere). Differentiable.
+    Returns outputs [M, mb, ...], sharded over the pipe axis on the M dim.
+    Differentiable.
     """
     s = mesh.shape[axis]
-    from jax.experimental.shard_map import shard_map
 
-    def shard_body(params, x_mb):
-        # params: this device's stage slice, leading dim 1 — drop it
+    def shard_body(params, x_loc):
+        # params: this device's stage slice, leading dim 1 — drop it.
+        # x_loc: [M/S, mb, ...] — this device's slab of microbatches.
         params = jax.tree.map(lambda p: p[0], params)
         idx = jax.lax.axis_index(axis)
-        m = x_mb.shape[0]
+        mloc = x_loc.shape[0]
+        m = mloc * s
         ticks = m + s - 1
-        out0 = jnp.zeros_like(x_mb)
-        recv0 = jnp.zeros_like(x_mb[0])
+        out = jnp.zeros_like(x_loc)
+        recv = jnp.zeros_like(x_loc[0])
         fwd_perm = [(i, i + 1) for i in range(s - 1)]
 
-        def tick(t, carry):
-            recv, out = carry
+        # Unrolled schedule: tick t processes microbatch t-stage on each
+        # stage. Static t makes the feed/collect ppermute pairs static.
+        for t in range(ticks):
+            if t < m:
+                owner, loc = divmod(t, mloc)
+                feed = x_loc[loc]
+                if owner != 0:
+                    # owner ships microbatch t to stage 0 (mb-sized ICI hop)
+                    feed = jax.lax.ppermute(feed, axis, [(owner, 0)])
+            else:
+                feed = jnp.zeros_like(recv)
+            inp = jnp.where(idx == 0, feed, recv)
+            y = stage_fn(params, inp)
             mb_idx = t - idx
             active = (mb_idx >= 0) & (mb_idx < m)
-            inp = jnp.where(idx == 0, x_mb[jnp.clip(t, 0, m - 1)], recv)
-            y = stage_fn(params, inp)
             y = jnp.where(active, y, jnp.zeros_like(y))
-            write = (idx == s - 1) & active
-            slot = jnp.clip(mb_idx, 0, m - 1)
-            out = out.at[slot].set(jnp.where(write, y, out[slot]))
-            recv = jax.lax.ppermute(y, axis, fwd_perm)
-            return recv, out
-
-        _, out = jax.lax.fori_loop(0, ticks, tick, (recv0, out0))
-        # broadcast the last stage's outputs to every pipe position so the
-        # caller can compute the loss anywhere: all-reduce of the masked
-        # buffer (only stage S-1 holds nonzeros)
-        out = jnp.where(idx == s - 1, out, jnp.zeros_like(out))
-        return jax.lax.psum(out, axis)
+            done = t - (s - 1)  # microbatch finishing at the last stage
+            if done >= 0:
+                owner_out, loc_out = divmod(done, mloc)
+                w = y
+                if owner_out != s - 1:
+                    w = jax.lax.ppermute(w, axis, [(s - 1, owner_out)])
+                out = out.at[loc_out].set(
+                    jnp.where(idx == owner_out, w, out[loc_out]))
+            if t < ticks - 1:
+                recv = jax.lax.ppermute(y, axis, fwd_perm)
+        return out
 
     def fn(stacked_params, microbatches):
+        m = microbatches.shape[0]
+        mpad = -(-m // s) * s
+        if mpad != m:  # ragged M: zero microbatches ride the bubble, sliced off
+            pad = [(0, mpad - m)] + [(0, 0)] * (microbatches.ndim - 1)
+            microbatches = jnp.pad(microbatches, pad)
         in_specs = (
             jax.tree.map(lambda _: P(axis), stacked_params),
-            P(),  # microbatches replicated; stage 0 reads them
+            P(axis),  # microbatch slabs live with their owner stage
         )
-        return shard_map(
-            shard_body, mesh=mesh, in_specs=in_specs, out_specs=P(),
-            check_rep=False,
+        out = _shard_map(
+            shard_body, mesh=mesh, in_specs=in_specs, out_specs=P(axis),
+            check_vma=False,
         )(stacked_params, microbatches)
+        return out[:m] if mpad != m else out
 
     return fn
 

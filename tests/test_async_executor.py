@@ -148,12 +148,13 @@ def test_strategy_knobs_warn_when_inert():
     with pytest.warns(UserWarning, match="no effect"):
         es.num_threads = 8
     bs = fluid.BuildStrategy()
-    with pytest.warns(UserWarning, match="GSPMD"):
-        bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
-    # honored knob must NOT warn
+    with pytest.warns(UserWarning, match="XLA"):
+        bs.fuse_all_reduce_ops = False
+    # honored knobs must NOT warn (reduce_strategy drives ZeRO-1 since r3)
     with warnings.catch_warnings():
         warnings.simplefilter("error")
         bs.gradient_accumulation_steps = 4
+        bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
 
 
 def test_enforce_error_carries_op_context(rng):

@@ -15,12 +15,24 @@ def _mesh(n, axis="expert"):
 
 
 def _dense_reference(x, gate_w, params, fn, capacity):
-    """Same routing math, computed without sharding/all_to_all."""
-    from paddle_tpu.parallel.moe import _dispatch_tensors
-
+    """Independent GShard-style one-hot dispatch (the round-2 formulation) —
+    same routing semantics as the sort-based production path."""
     b, t, d = x.shape
     flat = x.reshape(-1, d)
-    dispatch, combine, aux = _dispatch_tensors(flat @ gate_w, capacity)
+    gate_logits = flat @ gate_w
+    e = gate_logits.shape[-1]
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0
+    pos_in_expert = jnp.sum(pos * onehot, axis=1)
+    keep = pos_in_expert < capacity
+    gate = jnp.sum(probs * onehot, axis=1) * keep
+    slot = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), capacity,
+                          dtype=jnp.float32)
+    dispatch = onehot[:, :, None] * slot[:, None, :] * keep[:, None, None]
+    combine = dispatch * gate[:, None, None]
+    aux = e * jnp.sum(jnp.mean(onehot, axis=0) * jnp.mean(probs, axis=0))
     buf = jnp.einsum("nd,nec->ecd", flat.astype(jnp.float32), dispatch)
     out = jax.vmap(fn)(params, buf.astype(x.dtype))
     y = jnp.einsum("ecd,nec->nd", out.astype(jnp.float32), combine)

@@ -35,6 +35,26 @@ def test_fused_softmax_xent_grad_parity(rng):
     np.testing.assert_allclose(g1, g2, rtol=2e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("n,v", [(24, 1536), (17, 300)])
+def test_fused_softmax_xent_label_smoothing(rng, n, v):
+    """Smoothed loss/grad must match the composed formula (incl. v-padding)."""
+    eps = 0.1
+    logits = jnp.asarray(rng.randn(n, v).astype("float32") * 2)
+    labels = jnp.asarray(rng.randint(0, v, (n, 1)).astype("int32"))
+
+    def ref(x):
+        logp = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, labels.astype(jnp.int32), axis=-1)
+        return (1 - eps) * nll + (eps / v) * (-logp.sum(-1, keepdims=True))
+
+    loss = fused_softmax_xent(logits, labels, True, eps)
+    np.testing.assert_allclose(loss, ref(logits), rtol=2e-5, atol=2e-5)
+    w = jnp.asarray(rng.randn(n, 1).astype("float32"))
+    g1 = jax.grad(lambda x: (fused_softmax_xent(x, labels, True, eps) * w).sum())(logits)
+    g2 = jax.grad(lambda x: (ref(x) * w).sum())(logits)
+    np.testing.assert_allclose(g1, g2, rtol=2e-4, atol=1e-5)
+
+
 def test_fused_softmax_xent_bf16(rng):
     n, v = 16, 512
     logits = jnp.asarray(rng.randn(n, v).astype("float32")).astype(jnp.bfloat16)
