@@ -3,12 +3,14 @@ XLA-composed O(S²) path, fwd+bwd, bf16 causal. Chained-loop difference
 timing (k-vs-1 iterations inside one jit) cancels the axon tunnel's
 per-call round trip.
 
-Measured 2026-07-30 on v5e (b·h·d = 4·8·64):
-  S=2048: flash 5.22 ms vs composed 3.32 ms  → composed wins 1.57×
-  S=8192: flash 13.41 ms vs composed 16.39 ms → flash wins 1.22×
-These numbers set FLAGS_flash_attention_min_seq (ops/attention_ops.py
-_flash_ok): below the crossover XLA's fused attention is simply faster on
-this hardware; flash pays only once the S² intermediate dominates HBM.
+Measured 2026-07-30 on v5e (loop-difference timing, causal fwd+bwd):
+  r2 (f32 softmax): S=2048 flash 5.22 vs composed 3.32 ms; S=8192 13.41 vs 16.39
+  r3 (bf16 softmax): S=8192 flash 11.53 vs composed 4.03 ms;
+                     S=16384 flash 96.64 vs composed 59.45 ms
+After the composed path's softmax went dtype-preserving (bf16), XLA wins on
+SPEED at every shape that fits; FLAGS_flash_attention_min_seq is now a
+MEMORY gate (default 24576): the composed O(S²) buffers OOM around S~24k
+single-chip, where flash's O(S) memory is the only viable path.
 """
 
 import json

@@ -20,8 +20,10 @@ _DEFAULTS: Dict[str, Any] = {
     "benchmark": False,              # block_until_ready every step (operator.cc:942)
     "strict_fused_attention": False, # raise (not warn+fallback) if the Pallas
                                      # flash-attention call fails on TPU
-    "flash_attention_min_seq": 8192, # measured crossover vs XLA-composed
-                                     # attention on v5e (bench_attention.py)
+    "flash_attention_min_seq": 24576, # memory gate: composed attention's
+                                     # O(S^2) buffers OOM ~24k on v5e; flash
+                                     # is slower but O(S) (bench_attention.py,
+                                     # r3 re-measurement after bf16 softmax)
     "eager_delete_tensor_gb": 0.0,   # accepted; XLA buffer liveness handles it
     # accepted for compatibility, no-ops under XLA
     "fraction_of_gpu_memory_to_use": 0.92,

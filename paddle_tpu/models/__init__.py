@@ -1,5 +1,9 @@
 """Model zoo mirroring the reference benchmark configs
 (reference: benchmark/fluid/models/ — mnist, resnet, machine_translation;
-plus BERT and DeepFM from BASELINE.json's five workloads)."""
+plus BERT and DeepFM from BASELINE.json's five workloads) and the book-test
+models (reference: python/paddle/fluid/tests/book/ — word2vec,
+label_semantic_roles, recommender_system)."""
 
-from . import deepfm, machine_translation, mnist, resnet, se_resnext, stacked_lstm, transformer, vgg  # noqa: F401
+from . import (bert, deepfm, machine_translation, mnist, recommender, resnet,  # noqa: F401
+               se_resnext, semantic_roles, stacked_lstm, transformer, vgg,
+               word2vec)

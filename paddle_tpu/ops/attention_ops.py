@@ -39,12 +39,15 @@ def _on_tpu() -> bool:
 
 def _flash_ok(q, k, causal) -> bool:
     """Gates for the Pallas kernel: blocking constraints (seq multiples of
-    128) AND a measured profitability threshold — on v5e the XLA-composed
-    attention is FASTER below ~8k sequence (loop-difference microbench,
-    benchmarks/bench_attention.py: S=2048 flash 5.2ms vs composed 3.3ms;
-    S=8192 flash 13.4ms vs composed 16.4ms). Flash's O(S) memory only pays
-    once the S² intermediate dominates. FLAGS_flash_attention_min_seq tunes
-    the crossover per hardware."""
+    128) AND a measured threshold. Round-3 re-measurement on v5e (after the
+    composed path's softmax went dtype-preserving bf16): composed WINS on
+    speed at every shape that fits — S=8192 flash 11.5ms vs composed 4.0ms,
+    S=16384 flash 96.6ms vs composed 59.5ms (b1 h8 d64 causal fwd+bwd,
+    loop-difference timing). The gate is therefore a MEMORY gate, not a
+    speed gate: the composed path materializes O(S²) score buffers
+    (bf16 [b,h,S,S] ≈ 4GB per buffer at S=16k in a real model) and OOMs
+    around S~24k single-chip, where flash's O(S) memory is the only viable
+    path. FLAGS_flash_attention_min_seq tunes the switch per hardware."""
     flash, _ = _flash_fn()
     if flash is None or not _on_tpu():
         return False
