@@ -77,6 +77,11 @@ class BuildStrategy(_StrategyBase):
         "memory_optimize": "XLA buffer assignment + donation owns reuse",
         "enable_inplace": "XLA buffer donation owns in-place updates",
         "fuse_all_reduce_ops": "XLA fuses collectives itself",
+        "gradient_scale_strategy": "GSPMD computes the GLOBAL batch mean "
+            "directly (loss reduces over the sharded batch), which is "
+            "exactly CoeffNumDevice semantics; One/Customized would "
+            "require per-device loss scaling that the single fused "
+            "program has no seam for",
     }
 
     def __init__(self):

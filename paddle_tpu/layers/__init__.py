@@ -13,11 +13,27 @@ from .detection import (  # noqa: F401
     multiclass_nms,
     polygon_box_transform,
     prior_box,
+    detection_map,
+    generate_mask_labels,
+    generate_proposal_labels,
     roi_align,
+    roi_perspective_transform,
     roi_pool,
+    rpn_target_assign,
     ssd_loss,
     target_assign,
     yolov3_loss,
+)
+from .learning_rate_scheduler import (  # noqa: F401
+    append_LARS,
+    cosine_decay,
+    exponential_decay,
+    inverse_time_decay,
+    linear_lr_warmup,
+    natural_exp_decay,
+    noam_decay,
+    piecewise_decay,
+    polynomial_decay,
 )
 from .beam_search import (  # noqa: F401
     array_length,
@@ -48,7 +64,9 @@ from .control_flow import (  # noqa: F401
     not_equal,
 )
 from .sequence import *  # noqa: F401,F403
-from .io import create_py_reader_by_data, data, double_buffer, py_reader, read_file  # noqa: F401
+from .io import (batch, create_py_reader_by_data, data, double_buffer, load,  # noqa: F401
+                 py_reader, read_file, shuffle)
+from .control_flow import is_empty  # noqa: F401
 from .layer_helper import LayerHelper, ParamAttr  # noqa: F401
 from .nn import *  # noqa: F401,F403
 from .layer_function_generator import *  # noqa: F401,F403

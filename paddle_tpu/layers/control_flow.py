@@ -20,7 +20,7 @@ from . import tensor as tl
 
 __all__ = ["While", "cond", "StaticRNN", "DynamicRNN", "less_than",
            "less_equal", "greater_than", "greater_equal", "equal", "not_equal",
-           "logical_and", "logical_or", "logical_not", "increment"]
+           "logical_and", "logical_or", "logical_not", "increment", "is_empty"]
 
 
 def _cmp_layer(op_type, x, y, cond=None):
@@ -616,3 +616,12 @@ class Switch:
 
 
 __all__ += ["IfElse", "Switch"]
+
+
+def is_empty(x, cond=None):
+    """True iff x has zero elements (reference: control_flow.py is_empty →
+    operators/is_empty_op.cc)."""
+    helper = LayerHelper("is_empty")
+    out = cond if cond is not None else helper.create_variable_for_type_inference("bool")
+    helper.append_op("is_empty", inputs={"X": x}, outputs={"Out": out})
+    return out

@@ -456,3 +456,73 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes, im_info,
 
 
 __all__ += ["rpn_target_assign", "generate_proposal_labels"]
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              batch_id=None):
+    """Perspective-warp quad RoIs (reference: detection.py
+    roi_perspective_transform → roi_perspective_transform_op.cc). rois:
+    [R, 8] quad corners; batch_id [R] replaces the reference's RoI LoD."""
+    helper = LayerHelper("roi_perspective_transform")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": input, "ROIs": rois}
+    if batch_id is not None:
+        inputs["BatchId"] = batch_id
+    helper.append_op(
+        "roi_perspective_transform", inputs=inputs, outputs={"Out": out},
+        attrs={"transformed_height": int(transformed_height),
+               "transformed_width": int(transformed_width),
+               "spatial_scale": float(spatial_scale)})
+    return out
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution,
+                         gt_poly_length=None):
+    """Mask R-CNN mask targets (reference: detection.py generate_mask_labels
+    → generate_mask_labels_op.cc). gt_segms: [B, Ng, L, 2] padded polygons
+    (+ gt_poly_length [B, Ng]) replace the 3-level LoD."""
+    helper = LayerHelper("generate_mask_labels")
+    mask = helper.create_variable_for_type_inference("int32")
+    has = helper.create_variable_for_type_inference("int32")
+    inputs = {"ImInfo": im_info, "GtClasses": gt_classes, "IsCrowd": is_crowd,
+              "GtSegms": gt_segms, "Rois": rois, "LabelsInt32": labels_int32}
+    if gt_poly_length is not None:
+        inputs["GtPolyLength"] = gt_poly_length
+    helper.append_op(
+        "generate_mask_labels", inputs=inputs,
+        outputs={"MaskInt32": mask, "RoiHasMaskInt32": has},
+        attrs={"num_classes": int(num_classes), "resolution": int(resolution)})
+    return mask, has
+
+
+def detection_map(detect_res, label, class_num=None, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version="integral", det_length=None):
+    """Per-batch mAP (reference: detection.py detection_map →
+    detection_map_op.cc). Padded convention: detect_res [B, K, 6]
+    (+ det_length [B]), label [B, Ng, 5]. Cross-batch accumulation lives in
+    metrics.DetectionMAP; the reference's streaming state inputs are not
+    supported here."""
+    if input_states is not None or out_states is not None or has_state is not None:
+        raise NotImplementedError(
+            "detection_map: streaming accumulator states are handled by "
+            "paddle_tpu.metrics.DetectionMAP; per-batch mAP only here")
+    helper = LayerHelper("detection_map")
+    out = helper.create_variable_for_type_inference("float32")
+    inputs = {"DetectRes": detect_res, "Label": label}
+    if det_length is not None:
+        inputs["DetLength"] = det_length
+    helper.append_op(
+        "detection_map", inputs=inputs, outputs={"MAP": out},
+        attrs={"overlap_threshold": float(overlap_threshold),
+               "ap_type": ap_version,
+               "evaluate_difficult": bool(evaluate_difficult),
+               "background_label": int(background_label)})
+    return out
+
+
+__all__ += ["roi_perspective_transform", "generate_mask_labels",
+            "detection_map"]

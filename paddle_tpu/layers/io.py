@@ -97,3 +97,46 @@ def double_buffer(reader, place=None, name=None):
     """Compat shim: py_reader(use_double_buffer=True) already device-prefetches
     (reader/prefetcher.py); returns the reader unchanged."""
     return reader
+
+
+def shuffle(reader, buffer_size):
+    """reference: layers/io.py shuffle (create_shuffle_reader op). The
+    file-reader op stack is replaced by host-side reader decorators
+    (SURVEY §2 reader infra): this delegates to
+    ``paddle_tpu.reader.shuffle`` for Python readers."""
+    if callable(reader):
+        from ..reader.decorator import shuffle as _shuffle
+
+        return _shuffle(reader, buffer_size)
+    raise TypeError(
+        "layers.shuffle expects a Python reader callable; the reference's "
+        "graph reader Variables (open_files) are replaced by py_reader + "
+        "reader decorators on this backend")
+
+
+def batch(reader, batch_size):
+    """reference: layers/io.py batch (create_batch_reader op); delegates to
+    ``paddle_tpu.reader.batch`` for Python readers."""
+    if callable(reader):
+        from ..reader.decorator import batch as _batch
+
+        return _batch(reader, batch_size)
+    raise TypeError(
+        "layers.batch expects a Python reader callable; the reference's "
+        "graph reader Variables (open_files) are replaced by py_reader + "
+        "reader decorators on this backend")
+
+
+def load(out, file_path, load_as_fp16=None):
+    """Load a saved variable into ``out`` (reference: layers/io.py load →
+    operators/load_op.cc); reads the .npy written by io.save_vars."""
+    from .layer_helper import LayerHelper
+
+    helper = LayerHelper("load")
+    helper.append_op("load", inputs={}, outputs={"Out": out},
+                     attrs={"file_path": file_path,
+                            "load_as_fp16": bool(load_as_fp16 or False)})
+    return out
+
+
+__all__ += ["shuffle", "batch", "load"]

@@ -138,3 +138,30 @@ def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
     cond = helper.create_variable_for_type_inference("bool")
     helper.append_op("less_than", inputs={"X": step, "Y": ws}, outputs={"Out": cond})
     return nn.where(cond, warm, learning_rate)
+
+
+def append_LARS(params_grads, learning_rate, weight_decay):
+    """LARS layer-wise lr scaling (reference:
+    layers/learning_rate_scheduler.py append_LARS):
+    lr_i = lr · ||p|| / (||g|| + weight_decay·||p||) per parameter.
+    Returns the list of per-parameter decayed learning rates. (For the
+    optimizer-integrated variant see optimizer.LarsMomentumOptimizer.)"""
+    from . import nn, tensor
+
+    decayed = []
+    for param, grad in params_grads:
+        p_norm = nn.sqrt(nn.reduce_sum(nn.square(param)))
+        g_norm = nn.sqrt(nn.reduce_sum(nn.square(grad)))
+        # reference _balanced_weight: wd == 1.0 → ||g|| + ||p||, else
+        # ||g|| + wd·||p||
+        ratio = nn.elementwise_add(
+            g_norm, p_norm if weight_decay == 1.0
+            else tensor.scale(p_norm, scale=float(weight_decay)))
+        local = nn.elementwise_div(p_norm, ratio)
+        decayed.append(nn.elementwise_mul(local, learning_rate)
+                       if hasattr(learning_rate, "name")
+                       else tensor.scale(local, scale=float(learning_rate)))
+    return decayed
+
+
+__all__.append("append_LARS")

@@ -255,3 +255,23 @@ def has_nan(x):
     out = helper.create_variable_for_type_inference("bool")
     helper.append_op("has_nan", inputs={"X": x}, outputs={"Out": out})
     return out
+
+
+def tensor_array_to_tensor(input, axis=1, name=None):
+    """Concatenate a TensorArray's entries along ``axis`` (reference:
+    layers/tensor.py tensor_array_to_tensor →
+    operators/tensor_array_to_tensor_op.cc). Returns (out, out_index) where
+    out_index holds each entry's extent along the axis."""
+    from .layer_helper import LayerHelper
+
+    helper = LayerHelper("tensor_array_to_tensor", name=name)
+    out = helper.create_variable_for_type_inference(
+        getattr(input, "elem_dtype", "float32"))
+    idx = helper.create_variable_for_type_inference("int32")
+    helper.append_op("tensor_array_to_tensor", inputs={"X": input},
+                     outputs={"Out": out, "OutIndex": idx},
+                     attrs={"axis": int(axis)})
+    return out, idx
+
+
+__all__.append("tensor_array_to_tensor")

@@ -150,3 +150,22 @@ def beam_search_decode_op(ctx: OpContext):
     sent = jnp.flip(outs, axis=0).transpose(1, 2, 0)
     ctx.set_output("SentenceIds", sent)
     ctx.set_output("SentenceScores", scores)
+
+
+@register_op("tensor_array_to_tensor")
+def tensor_array_to_tensor_op(ctx: OpContext):
+    """Concatenate TensorArray entries along ``axis`` (reference:
+    operators/tensor_array_to_tensor_op.cc). Static-shape contract (the
+    padded+Length convention): the concat spans the array's full capacity —
+    slots past the write count hold zeros — and OutIndex carries each
+    entry's extent along the axis, 0 for unwritten slots, so consumers mask
+    exactly like every Length-carrying op here. Size the array's capacity
+    to the real entry count to avoid padding (create_array/array_write)."""
+    buf, count = ctx.input("X")
+    axis = ctx.attr("axis", 1)
+    k = buf.shape[0]
+    out = jnp.concatenate([buf[i] for i in range(k)], axis=axis)
+    ctx.set_output("Out", out)
+    extent = buf.shape[1:][axis]
+    ctx.set_output("OutIndex",
+                   jnp.where(jnp.arange(k) < count, extent, 0).astype(jnp.int32))

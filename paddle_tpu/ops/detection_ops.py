@@ -1183,3 +1183,51 @@ def roi_perspective_transform_op(ctx: OpContext):
         return out
 
     ctx.set_output("Out", jax.vmap(one)(rois, batch_id.astype(jnp.int32)))
+
+
+@register_op("detection_map")
+def detection_map_op(ctx: OpContext):
+    """mAP over padded detections (reference: operators/detection_map_op.cc).
+
+    DetectRes [B, K, 6] (label, score, x1, y1, x2, y2; -1 pad rows),
+    Label [B, Ng, 5] (label, x1, y1, x2, y2; zero-area pad rows), optional
+    DetLength [B]. Matching/AP run on host via pure_callback (branchy
+    per-box logic, negligible next to the detector itself); per-batch mAP
+    only — cross-batch accumulation lives in metrics.DetectionMAP.
+    """
+    import jax
+
+    det = ctx.input("DetectRes")
+    gt = ctx.input("Label")
+    det_len = ctx.input("DetLength")
+    if det_len is None:
+        det_len = jnp.full((det.shape[0],), det.shape[1], jnp.int32)
+    overlap = ctx.attr("overlap_threshold", 0.5)
+    ap_version = ctx.attr("ap_type", "integral")
+    background = int(ctx.attr("background_label", 0))
+    if not ctx.attr("evaluate_difficult", True):
+        # the padded 5-col gt rows carry no difficult flag to exclude
+        raise NotImplementedError(
+            "detection_map: evaluate_difficult=False needs per-gt difficult "
+            "flags, which the padded [label,x1,y1,x2,y2] convention does not "
+            "carry — filter difficult gts out of the feed instead")
+
+    def host_map(det_h, len_h, gt_h):
+        import numpy as np
+
+        from ..metrics import DetectionMAP
+
+        det_h = np.array(det_h, copy=True)
+        gt_h = np.array(gt_h, copy=True)
+        if background >= 0:
+            # background rows don't score: void matched det rows and
+            # zero-area the background gts (the metric skips both)
+            det_h[det_h[..., 0] == background] = -1.0
+            gt_h[gt_h[..., 0] == background] = 0.0
+        m = DetectionMAP(overlap_threshold=overlap, ap_version=ap_version)
+        m.update(det_h, len_h, gt_h)
+        return np.float32(m.eval())
+
+    out = jax.pure_callback(
+        host_map, jax.ShapeDtypeStruct((), jnp.float32), det, det_len, gt)
+    ctx.set_output("MAP", out)

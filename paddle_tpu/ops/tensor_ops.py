@@ -454,3 +454,28 @@ def space_to_depth_op(ctx: OpContext):
     n, c, h, w = x.shape
     out = x.reshape(n, c, h // b, b, w // b, b).transpose(0, 3, 5, 1, 2, 4).reshape(n, c * b * b, h // b, w // b)
     ctx.set_output("Out", out)
+
+
+@register_op("load")
+def load_op(ctx: OpContext):
+    """Reference: operators/load_op.cc. The file is read at trace time (the
+    trace-once analog of the per-run load; re-tracing reloads) from the
+    .npy/.npz format written by paddle_tpu.io.save_vars. For a combined
+    .npz archive the entry matching the output variable's name is loaded."""
+    import numpy as np
+
+    path = ctx.attr("file_path")
+    data = np.load(path, allow_pickle=False)
+    if isinstance(data, np.lib.npyio.NpzFile):
+        key = ctx.op.outputs["Out"][0]
+        if key not in data:
+            raise KeyError(
+                "load: %r has no entry %r (archive keys: %s)"
+                % (path, key, sorted(data.files)))
+        arr = data[key]
+    else:
+        arr = data
+    out = jnp.asarray(arr)
+    if ctx.attr("load_as_fp16", False):
+        out = out.astype(jnp.float16)
+    ctx.set_output("Out", out)

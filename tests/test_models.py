@@ -410,3 +410,29 @@ def test_bert_named_configs_and_tiny_convergence(rng):
     losses = [float(exe.run(main, feed=feed, fetch_list=[total])[0])
               for _ in range(25)]
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_resnet_nhwc_matches_nchw(rng):
+    """data_format='NHWC' (the TPU-native channels-last layout) must be
+    numerically identical to NCHW through training steps."""
+    def run(fmt):
+        with fluid.unique_name.guard():
+            with fluid.scope_guard(fluid.Scope()):
+                main, startup = fluid.Program(), fluid.Program()
+                main.random_seed = startup.random_seed = 7
+                with fluid.program_guard(main, startup):
+                    img = fluid.layers.data("img", shape=[3, 16, 16])
+                    label = fluid.layers.data("label", shape=[1], dtype="int64")
+                    logits, loss, acc = resnet_mod.resnet(
+                        img, label, depth=18, class_num=10, data_format=fmt)
+                    fluid.optimizer.Momentum(0.1, 0.9).minimize(loss)
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                r = np.random.RandomState(0)
+                feed = {"img": r.randn(4, 3, 16, 16).astype("float32"),
+                        "label": r.randint(0, 10, (4, 1)).astype("int64")}
+                return [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+                        for _ in range(3)]
+
+    # layout changes fp32 reduction order; drift compounds over train steps
+    np.testing.assert_allclose(run("NCHW"), run("NHWC"), rtol=5e-3, atol=1e-3)
