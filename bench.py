@@ -74,7 +74,7 @@ def _device_feed(feed):
     return {k: jax.device_put(v) for k, v in feed.items()}
 
 
-def _timeit(run_step, batch, skip=3, iters=10):
+def _timeit(run_step, batch, skip=5, iters=20):
     """Dispatch ``iters`` chained steps, then force the FINAL loss value to
     the host. Each step's state feeds the next, so the value fetch
     transitively executes the whole chain; fetching bytes (np.asarray) is the
@@ -187,7 +187,10 @@ def bench_raw_jax_resnet50(batch=64, image=224, classes=1000):
         return jax.random.normal(next(keys), (cout, cin, k, k), jnp.float32) * (2.0 / fan) ** 0.5
 
     def bn_p(c):
-        return {"g": jnp.ones((c,)), "b": jnp.zeros((c,))}
+        # running mean/var included so the yardstick does the SAME work as
+        # the framework step (EMA updates ride along in the state)
+        return {"g": jnp.ones((c,)), "b": jnp.zeros((c,)),
+                "rm": jnp.zeros((c,)), "rv": jnp.ones((c,))}
 
     params = {"stem": conv_p(3, 64, 7), "stem_bn": bn_p(64)}
     cin = 64
@@ -209,47 +212,63 @@ def bench_raw_jax_resnet50(batch=64, image=224, classes=1000):
         return jax.lax.conv_general_dilated(
             x, w, (stride, stride), [(pad, pad)] * 2, dimension_numbers=dn)
 
-    def bn(x, p):
+    def bn(x, p, stats, nm):
         n_el = x.shape[0] * x.shape[2] * x.shape[3]
         m = jnp.sum(x, (0, 2, 3), dtype=jnp.float32) / n_el
         v = (jnp.sum(jnp.square(x.astype(jnp.float32)), (0, 2, 3),
                      dtype=jnp.float32) / n_el - m ** 2)
+        stats[nm] = (0.9 * p["rm"].astype(jnp.float32) + 0.1 * m,
+                     0.9 * p["rv"].astype(jnp.float32) + 0.1 * v)
         inv = jax.lax.rsqrt(v + 1e-5).astype(x.dtype)
         sh = (1, -1, 1, 1)
         return ((x - m.astype(x.dtype).reshape(sh)) * inv.reshape(sh)
                 * p["g"].astype(x.dtype).reshape(sh)
                 + p["b"].astype(x.dtype).reshape(sh))
 
-    def block(x, p, stride):
-        h = jax.nn.relu(bn(conv(x, p["c1"], 1), p["bn1"]))
-        h = jax.nn.relu(bn(conv(h, p["c2"], stride), p["bn2"]))
-        h = bn(conv(h, p["c3"], 1), p["bn3"])
+    def block(x, p, stride, stats, nm):
+        h = jax.nn.relu(bn(conv(x, p["c1"], 1), p["bn1"], stats, nm + "/bn1"))
+        h = jax.nn.relu(bn(conv(h, p["c2"], stride), p["bn2"], stats, nm + "/bn2"))
+        h = bn(conv(h, p["c3"], 1), p["bn3"], stats, nm + "/bn3")
         if "sc" in p:
-            x = bn(conv(x, p["sc"], stride), p["sbn"])
+            x = bn(conv(x, p["sc"], stride), p["sbn"], stats, nm + "/sbn")
         return jax.nn.relu(x + h)
 
     def loss_fn(params32, img, lbl):
         p = jax.tree_util.tree_map(
             lambda t: t.astype(jnp.bfloat16) if t.dtype == jnp.float32 else t,
             params32)
+        stats = {}
         x = img.astype(jnp.bfloat16)
-        x = jax.nn.relu(bn(conv(x, p["stem"], 2), p["stem_bn"]))
+        x = jax.nn.relu(bn(conv(x, p["stem"], 2), p["stem_bn"], stats, "stem_bn"))
         x = jax.lax.reduce_window(
             x, -jnp.inf, jax.lax.max, (1, 1, 3, 3), (1, 1, 2, 2),
             [(0, 0), (0, 0), (1, 1), (1, 1)])
         for si, (mid, cout, n, stride) in enumerate(cfg):
             for bi in range(n):
-                x = block(x, p["s%d_%d" % (si, bi)], stride if bi == 0 else 1)
+                nm = "s%d_%d" % (si, bi)
+                x = block(x, p[nm], stride if bi == 0 else 1, stats, nm)
         x = x.mean((2, 3))
         logits = (x @ p["fc_w"] + p["fc_b"]).astype(jnp.float32)
         logp = jax.nn.log_softmax(logits)
-        return -jnp.take_along_axis(logp, lbl, axis=-1).mean()
+        acc = (logits.argmax(-1) == lbl[:, 0]).mean()  # framework fetches acc-able graph
+        loss = -jnp.take_along_axis(logp, lbl, axis=-1).mean()
+        return loss, (stats, acc)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, mom, img, lbl):
-        loss, g = jax.value_and_grad(loss_fn)(params, img, lbl)
+        (loss, (stats, _acc)), g = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, img, lbl)
         mom = jax.tree_util.tree_map(lambda m, gg: 0.9 * m + gg, mom, g)
         params = jax.tree_util.tree_map(lambda p_, m: p_ - 0.1 * m, params, mom)
+        # write back running stats (EMA) by name, matching the framework's BN
+        params = dict(params)
+        for nm, (rm, rv) in stats.items():
+            tree = params
+            *path, leaf = nm.split("/")
+            for kk in path:
+                tree[kk] = dict(tree[kk])
+                tree = tree[kk]
+            tree[leaf] = dict(tree[leaf], rm=rm, rv=rv)
         return params, mom, loss
 
     import jax as _jax

@@ -90,3 +90,30 @@ def test_switch_moe_capacity_drops(rng):
     # here: (0.5 * 6 / 2)=1 → only 1 token served, rest dropped to zeros
     nonzero_rows = int((np.abs(np.asarray(y).reshape(t, d)).sum(-1) > 1e-6).sum())
     assert nonzero_rows == 1, nonzero_rows
+
+
+def test_switch_moe_composes_with_data_axis(rng):
+    """The docstring's dp×ep claim: batch sharded over 'data', experts over
+    'expert', on a 2-axis mesh — same numbers as the single-axis run."""
+    from jax.sharding import NamedSharding
+
+    e, d, dff, b, t = 4, 8, 16, 4, 8
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh2 = Mesh(devs, ("data", "expert"))
+    init, fn = make_switch_ffn(d, dff)
+    params = init(jax.random.PRNGKey(0), e)
+    gate_w = jnp.asarray(rng.randn(d, e).astype("float32") * 0.5)
+    x = jnp.asarray(rng.randn(b, t, d).astype("float32"))
+
+    # single-device reference (no sharding at all)
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("expert",))
+    y_ref, aux_ref = jax.jit(lambda xx: switch_moe(xx, gate_w, params, fn,
+                                                   mesh1))(x)
+
+    ps = jax.tree.map(
+        lambda p: jax.device_put(p, NamedSharding(mesh2, P("expert"))), params)
+    xs = jax.device_put(x, NamedSharding(mesh2, P("data")))
+    y, aux = jax.jit(lambda xx: switch_moe(xx, gate_w, ps, fn, mesh2))(xs)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-5)
