@@ -63,7 +63,7 @@ from .core.pass_framework import (  # noqa: F401
 from .core.place import CPUPlace, CUDAPinnedPlace, TPUPlace, is_compiled_with_tpu  # noqa: F401
 from .core.scope import Scope, global_scope, scope_guard  # noqa: F401
 from .executor import Executor  # noqa: F401
-from .layers.layer_helper import ParamAttr  # noqa: F401
+from .layers.layer_helper import ParamAttr, WeightNormParamAttr  # noqa: F401
 
 # Fluid compatibility: CUDAPlace maps to the accelerator (TPU) place.
 CUDAPlace = TPUPlace

@@ -7,6 +7,7 @@ once, default_main_program runs per step).
 
 from __future__ import annotations
 
+import contextlib
 import math
 
 import numpy as np
@@ -194,3 +195,29 @@ def _global_weight_initializer():
 
 def _global_bias_initializer():
     return ConstantInitializer(0.0)
+
+
+_force_init_on_cpu_flag = False
+
+
+def force_init_on_cpu():
+    """reference: initializer.py force_init_on_cpu — query the init-on-cpu
+    flag. Under XLA, initializers run inside the compiled startup program on
+    the device; the flag is tracked for API parity only."""
+    return _force_init_on_cpu_flag
+
+
+@contextlib.contextmanager
+def init_on_cpu():
+    """reference: initializer.py init_on_cpu context. No device switch is
+    needed on TPU (XLA places initialization), so this only toggles the
+    queryable flag."""
+    global _force_init_on_cpu_flag
+    prev, _force_init_on_cpu_flag = _force_init_on_cpu_flag, True
+    try:
+        yield
+    finally:
+        _force_init_on_cpu_flag = prev
+
+
+__all__ += ["force_init_on_cpu", "init_on_cpu"]

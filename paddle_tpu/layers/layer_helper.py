@@ -53,6 +53,21 @@ class ParamAttr:
         raise TypeError("cannot interpret %r as ParamAttr" % (arg,))
 
 
+class WeightNormParamAttr(ParamAttr):
+    """Weight-normalization reparameterization w = g·v/‖v‖ (reference:
+    param_attr.py:178). ``dim`` selects the slice axis whose magnitudes
+    ``g`` are learned independently (None → one global magnitude)."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 gradient_clip=None, do_model_average=False):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate, regularizer=regularizer,
+                         trainable=trainable, gradient_clip=gradient_clip,
+                         do_model_average=do_model_average)
+        self.dim = dim
+
+
 class LayerHelper:
     def __init__(self, layer_type: str, **kwargs):
         self.kwargs = kwargs
@@ -92,6 +107,9 @@ class LayerHelper:
         attr = ParamAttr.to_attr(attr)
         if attr.name is None:
             attr.name = unique_name.generate(".".join([self.name, "b" if is_bias else "w"]))
+        if isinstance(attr, WeightNormParamAttr):
+            return self._create_weight_normalized(
+                attr, shape, dtype, default_initializer)
         if default_initializer is None:
             if is_bias:
                 default_initializer = init_mod._global_bias_initializer()
@@ -120,6 +138,77 @@ class LayerHelper:
         )
         param.optimize_attr = {"learning_rate": attr.learning_rate}
         return param
+
+    def _create_weight_normalized(self, attr, shape, dtype,
+                                  default_initializer):
+        """Weight normalization (reference: param_attr.py WeightNormParamAttr
+        + layer_helper.py __weight_normalize): w = g · v/‖v‖ with direction
+        ``v`` and per-slice magnitude ``g`` as the trainable parameters.
+        ``g`` is initialized to ‖v‖ in the startup program so training
+        starts at w == v, matching the reference."""
+        dim = attr.dim
+        base = ParamAttr(name=attr.name + ".w_v", initializer=attr.initializer,
+                         learning_rate=attr.learning_rate,
+                         regularizer=attr.regularizer,
+                         trainable=attr.trainable,
+                         gradient_clip=attr.gradient_clip)
+        v = self.create_parameter(base, shape, dtype,
+                                  default_initializer=default_initializer)
+        g_shape = [shape[dim]] if dim is not None else [1]
+        reduce_axes = ([a for a in range(len(shape)) if a != dim]
+                       if dim is not None else list(range(len(shape))))
+        bshape = [1] * len(shape)
+        if dim is not None:
+            bshape[dim] = shape[dim]
+
+        def norm_ops(block, v_var, out_name_hint):
+            sq = block.create_var(name=unique_name.generate(out_name_hint + ".sq"),
+                                  shape=list(shape), dtype=dtype)
+            block.append_op("square", inputs={"X": v_var}, outputs={"Out": sq},
+                            attrs={})
+            ssum = block.create_var(name=unique_name.generate(out_name_hint + ".ss"),
+                                    shape=g_shape, dtype=dtype)
+            block.append_op("reduce_sum", inputs={"X": sq},
+                            outputs={"Out": ssum},
+                            attrs={"dim": reduce_axes, "keep_dim": False,
+                                   "reduce_all": dim is None})
+            nrm = block.create_var(name=unique_name.generate(out_name_hint + ".n"),
+                                   shape=g_shape, dtype=dtype)
+            block.append_op("sqrt", inputs={"X": ssum}, outputs={"Out": nrm},
+                            attrs={})
+            return nrm
+
+        # startup: g := ||v|| (so the initial effective weight equals v)
+        startup_block = self.startup_program.global_block
+        sg = startup_block.create_parameter(
+            name=attr.name + ".w_g", shape=g_shape, dtype=dtype,
+            trainable=attr.trainable)
+        s_norm = norm_ops(startup_block, startup_block.var(v.name), attr.name)
+        startup_block.append_op("assign", inputs={"X": s_norm},
+                                outputs={"Out": sg}, attrs={})
+        main_block = self.main_program.global_block
+        g = main_block.create_parameter(name=attr.name + ".w_g", shape=g_shape,
+                                        dtype=dtype, trainable=attr.trainable)
+        g.optimize_attr = {"learning_rate": attr.learning_rate}
+
+        # main: w = v * (g / ||v||), broadcast over the kept dim
+        m_norm = norm_ops(main_block, v, attr.name + ".m")
+        scale = main_block.create_var(
+            name=unique_name.generate(attr.name + ".scale"), shape=g_shape,
+            dtype=dtype)
+        main_block.append_op("elementwise_div", inputs={"X": g, "Y": m_norm},
+                             outputs={"Out": scale}, attrs={"axis": -1})
+        scale_r = main_block.create_var(
+            name=unique_name.generate(attr.name + ".scale_r"),
+            shape=bshape, dtype=dtype)
+        main_block.append_op("reshape", inputs={"X": scale},
+                             outputs={"Out": scale_r},
+                             attrs={"shape": bshape})
+        w = main_block.create_var(name=unique_name.generate(attr.name),
+                                  shape=list(shape), dtype=dtype)
+        main_block.append_op("elementwise_mul", inputs={"X": v, "Y": scale_r},
+                             outputs={"Out": w}, attrs={"axis": -1})
+        return w
 
     def create_global_variable(self, shape, dtype, name=None, persistable=False, stop_gradient=True):
         return self.main_program.global_block.create_var(

@@ -5,7 +5,8 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["MetricBase", "Accuracy", "Precision", "Recall", "Auc",
-           "EditDistance", "CompositeMetric"]
+           "EditDistance", "CompositeMetric", "ChunkEvaluator",
+           "DetectionMAP"]
 
 
 class MetricBase:
@@ -236,3 +237,33 @@ class DetectionMAP(MetricBase):
                     ap += (max(ps) if ps else 0.0) / 11.0
             aps.append(ap)
         return float(np.mean(aps)) if aps else 0.0
+
+
+class ChunkEvaluator(MetricBase):
+    """Accumulate chunk_eval op counters across mini-batches and derive
+    precision/recall/F1 (reference: python/paddle/fluid/metrics.py:359)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self, executor=None, reset_program=None):
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        import numpy as np
+
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks).sum())
+        self.num_label_chunks += int(np.asarray(num_label_chunks).sum())
+        self.num_correct_chunks += int(np.asarray(num_correct_chunks).sum())
+
+    def eval(self, executor=None):
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1

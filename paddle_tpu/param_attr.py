@@ -1,0 +1,6 @@
+"""``fluid.param_attr`` module alias (reference:
+python/paddle/fluid/param_attr.py) — the classes live with LayerHelper."""
+
+from .layers.layer_helper import ParamAttr, WeightNormParamAttr  # noqa: F401
+
+__all__ = ["ParamAttr", "WeightNormParamAttr"]

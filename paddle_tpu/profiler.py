@@ -109,3 +109,12 @@ class StepProfiler:
 
 
 __all__ += ["StepProfiler"]
+
+
+def reset_profiler():
+    """Clear collected profile data (reference: profiler.py reset_profiler).
+    jax.profiler traces are per start/stop window, so there is no global
+    accumulator to clear; provided for API parity."""
+
+
+__all__ += ["reset_profiler"]

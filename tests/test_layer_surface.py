@@ -372,3 +372,49 @@ def test_detection_map_layer(rng):
     gt_np[0, 0] = [1, 0.1, 0.1, 0.5, 0.5]
     val, = exe.run(main, feed={"det": det_np, "gt": gt_np}, fetch_list=[m])
     assert 0.99 < float(val) <= 1.0, val
+
+
+def test_weight_norm_param_attr(rng):
+    """WeightNormParamAttr: w = g*v/||v|| trains; g initialized to ||v|| so
+    training starts at w == v (reference param_attr.py:178 semantics)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8])
+        y = fluid.layers.data("y", shape=[1])
+        h = fluid.layers.fc(x, size=16, act="relu",
+                            param_attr=fluid.WeightNormParamAttr(dim=1))
+        out = fluid.layers.fc(h, size=1,
+                              param_attr=fluid.WeightNormParamAttr(dim=None))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(out, y))
+        fluid.optimizer.Adam(5e-2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = fluid.global_scope()
+    # g == ||v|| at init (per-column for dim=1)
+    v0 = scope.as_numpy("fc_0.w_0.w_v")
+    g0 = scope.as_numpy("fc_0.w_0.w_g")
+    np.testing.assert_allclose(g0, np.sqrt((v0 ** 2).sum(axis=0)), rtol=1e-5)
+    xs = rng.randn(64, 8).astype("float32")
+    ys = (xs[:, :1] * 0.5 + 0.2).astype("float32")
+    losses = [float(exe.run(main, feed={"x": xs, "y": ys},
+                            fetch_list=[loss])[0]) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+    # both g and v moved (trainable reparameterization)
+    assert not np.allclose(g0, scope.as_numpy("fc_0.w_0.w_g"))
+
+
+def test_chunk_evaluator_and_evaluator_namespace():
+    from paddle_tpu import evaluator, metrics
+
+    m = metrics.ChunkEvaluator()
+    m.update(np.array([10]), np.array([8]), np.array([6]))
+    m.update(2, 4, 2)
+    p, r, f1 = m.eval()
+    assert abs(p - 8 / 12) < 1e-9 and abs(r - 8 / 12) < 1e-9
+    assert abs(f1 - 8 / 12) < 1e-9
+    assert evaluator.ChunkEvaluator is metrics.ChunkEvaluator
+    assert evaluator.DetectionMAP is metrics.DetectionMAP
+    with fluid.initializer.init_on_cpu():
+        assert fluid.initializer.force_init_on_cpu()
+    assert not fluid.initializer.force_init_on_cpu()
+    fluid.profiler.reset_profiler()
