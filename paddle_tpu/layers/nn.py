@@ -570,12 +570,18 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-10
     into the single log_softmax pass instead of a second full-vocab traversal
     (the reference composes label_smooth + softmax_with_cross_entropy ops)."""
     helper = LayerHelper("softmax_with_cross_entropy")
-    softmax_out = helper.create_variable_for_type_inference(logits.dtype)
     loss = helper.create_variable_for_type_inference(logits.dtype)
+    # the Softmax slot is only declared when the caller asks for it — the
+    # exp(log_p) pass over the [N, V] logits (2GB at the bench shapes) must
+    # not ride along in every training step
+    outputs = {"Loss": loss}
+    if return_softmax:
+        softmax_out = helper.create_variable_for_type_inference(logits.dtype)
+        outputs["Softmax"] = softmax_out
     helper.append_op(
         "softmax_with_cross_entropy",
         inputs={"Logits": logits, "Label": label},
-        outputs={"Softmax": softmax_out, "Loss": loss},
+        outputs=outputs,
         attrs={"soft_label": soft_label, "ignore_index": ignore_index,
                "label_smoothing": float(label_smoothing)},
     )

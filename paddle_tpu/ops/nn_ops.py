@@ -68,6 +68,51 @@ def _fused_xent_ok(logits) -> bool:
             and softmax_xent_supported(n, logits.shape[-1], logits.dtype))
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _hard_label_xent(logits, lbl, smooth, ignore):
+    """Closed-form CE over hard int labels, with optional label smoothing.
+
+    The residuals are the (bf16) logits + a per-row logsumexp instead of the
+    f32 log-probabilities autodiff would save: two exp passes total
+    (fwd logsumexp, bwd softmax) and the [N, V]-sized saved buffer stays in
+    the input dtype — with a 30k vocab this removes ~2GB of f32 HBM traffic
+    per step vs differentiating through jax.nn.log_softmax."""
+    loss, _ = _hard_label_xent_fwd(logits, lbl, smooth, ignore)
+    return loss
+
+
+def _hard_label_xent_fwd(logits, lbl, smooth, ignore):
+    f = logits.astype(jnp.float32)
+    m = jnp.max(f, axis=-1, keepdims=True)
+    lse = m + jnp.log(jnp.sum(jnp.exp(f - m), axis=-1, keepdims=True))
+    picked = jnp.take_along_axis(f, jnp.maximum(lbl, 0)[..., None], axis=-1)
+    loss = lse - picked
+    if smooth:
+        k = logits.shape[-1]
+        sum_logp = jnp.sum(f, axis=-1, keepdims=True) - k * lse
+        loss = (1.0 - smooth) * loss + (smooth / k) * (-sum_logp)
+    loss = jnp.where((lbl != ignore)[..., None], loss, jnp.zeros_like(loss))
+    return loss, (logits, lbl, lse)
+
+
+def _hard_label_xent_bwd(smooth, ignore, res, g):
+    logits, lbl, lse = res
+    f = logits.astype(jnp.float32)
+    p = jnp.exp(f - lse)
+    k = logits.shape[-1]
+    onehot = (jax.lax.broadcasted_iota(jnp.int32, f.shape, f.ndim - 1)
+              == lbl[..., None])
+    if smooth:
+        d = p - (1.0 - smooth) * onehot - (smooth / k)
+    else:
+        d = p - onehot
+    g = jnp.where((lbl != ignore)[..., None], g, jnp.zeros_like(g))
+    return (g * d).astype(logits.dtype), None
+
+
+_hard_label_xent.defvjp(_hard_label_xent_fwd, _hard_label_xent_bwd)
+
+
 @register_op("softmax_with_cross_entropy")
 def softmax_with_cross_entropy_op(ctx: OpContext):
     """One log_softmax pass serves plain CE, soft labels, AND label
@@ -104,6 +149,15 @@ def softmax_with_cross_entropy_op(ctx: OpContext):
             sm = jnp.exp(f32 - jax.scipy.special.logsumexp(f32, axis=-1, keepdims=True))
             ctx.set_output("Softmax", jax.lax.stop_gradient(sm).astype(out_dtype))
         return
+    if not soft_label and not ctx.has_output("Softmax"):
+        # hard labels, no softmax requested: closed-form custom-vjp path
+        # (residuals are bf16 logits + lse, not f32 log-probs)
+        lbl = label.reshape(label.shape[:-1]) if label.shape[-1] == 1 else label
+        lbl = lbl.astype(jnp.int32)
+        loss = _hard_label_xent(logits, lbl, float(smooth),
+                                int(ctx.attr("ignore_index", -100)))
+        ctx.set_output("Loss", loss.astype(out_dtype))
+        return
     log_p = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     if soft_label:
         loss = -jnp.sum(label * log_p, axis=-1, keepdims=True)
@@ -119,7 +173,8 @@ def softmax_with_cross_entropy_op(ctx: OpContext):
                 -jnp.sum(log_p, axis=-1, keepdims=True))
         ignore = ctx.attr("ignore_index", -100)
         loss = jnp.where((lbl != ignore)[..., None], loss, jnp.zeros_like(loss))
-    ctx.set_output("Softmax", jnp.exp(log_p).astype(out_dtype))
+    if ctx.has_output("Softmax"):
+        ctx.set_output("Softmax", jnp.exp(log_p).astype(out_dtype))
     ctx.set_output("Loss", loss.astype(out_dtype))
 
 
@@ -313,6 +368,65 @@ def batch_norm_op(ctx: OpContext):
         ctx.set_output("Y", _bn_train(x, scale, bias, reduce_axes, eps))
 
 
+def _ln_stats(x, axes):
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    mean = jnp.sum(x, axis=axes, keepdims=True, dtype=jnp.float32) / n
+    var = (jnp.sum(jnp.square(x.astype(jnp.float32)), axis=axes,
+                   keepdims=True, dtype=jnp.float32) / n - jnp.square(mean))
+    return mean, var, n
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ln_train(x, scale, bias, axis, eps):
+    """Layer norm with the closed-form backward — same HBM rationale as
+    _bn_train: f32 accumulation off the bf16 input, residuals in x.dtype."""
+    y, _ = _ln_train_fwd(x, scale, bias, axis, eps)
+    return y
+
+
+def _ln_train_fwd(x, scale, bias, axis, eps):
+    axes = tuple(range(axis, x.ndim))
+    mean, var, _ = _ln_stats(x, axes)
+    inv = jax.lax.rsqrt(var + eps)
+    xhat = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
+    norm_shape = x.shape[axis:]
+    y = xhat
+    if scale is not None:
+        y = y * scale.astype(x.dtype).reshape(norm_shape)
+    if bias is not None:
+        y = y + bias.astype(x.dtype).reshape(norm_shape)
+    return y, (x, scale, bias, mean, inv)
+
+
+def _ln_train_bwd(axis, eps, res, dy):
+    x, scale, bias, mean, inv = res
+    axes = tuple(range(axis, x.ndim))
+    lead = tuple(range(axis))
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    norm_shape = x.shape[axis:]
+    xhat = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
+    dscale = (jnp.sum((dy * xhat).astype(jnp.float32), axis=lead)
+              .reshape(-1) if scale is not None else None)
+    dbias = (jnp.sum(dy, axis=lead, dtype=jnp.float32).reshape(-1)
+             if bias is not None else None)
+    dyh = dy * scale.astype(dy.dtype).reshape(norm_shape) if scale is not None else dy
+    s1 = jnp.sum(dyh, axis=axes, keepdims=True, dtype=jnp.float32)
+    s2 = jnp.sum((dyh * xhat).astype(jnp.float32), axis=axes, keepdims=True,
+                 dtype=jnp.float32)
+    coef = (inv / n).astype(x.dtype)
+    dx = coef * (n * dyh - s1.astype(x.dtype) - xhat * s2.astype(x.dtype))
+    return (dx,
+            dscale.astype(scale.dtype) if scale is not None else None,
+            dbias.astype(bias.dtype) if bias is not None else None)
+
+
+_ln_train.defvjp(_ln_train_fwd, _ln_train_bwd)
+
+
 @register_op("layer_norm")
 def layer_norm_op(ctx: OpContext):
     """Reference: operators/layer_norm_op.cc — normalize over dims >= begin_norm_axis."""
@@ -320,23 +434,13 @@ def layer_norm_op(ctx: OpContext):
     axis = ctx.attr("begin_norm_axis", 1)
     eps = ctx.attr("epsilon", 1e-5)
     axes = tuple(range(axis, x.ndim))
-    # stats in f32 (catastrophic cancellation in bf16 means), but the
-    # normalize itself in x.dtype — the [B,S,D]-sized intermediates the VJP
-    # saves then stay bf16 under AMP instead of silently doubling HBM traffic
-    xf = x.astype(jnp.float32)
-    mean = jnp.mean(xf, axis=axes, keepdims=True)
-    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
-    inv_std = jax.lax.rsqrt(var + eps)
-    y = (x - mean.astype(x.dtype)) * inv_std.astype(x.dtype)
     scale, bias = ctx.input("Scale"), ctx.input("Bias")
-    norm_shape = x.shape[axis:]
-    if scale is not None:
-        y = y * scale.astype(x.dtype).reshape(norm_shape)
-    if bias is not None:
-        y = y + bias.astype(x.dtype).reshape(norm_shape)
-    ctx.set_output("Y", y)
-    ctx.set_output("Mean", mean.reshape(x.shape[:axis]).reshape(-1))
-    ctx.set_output("Variance", var.reshape(x.shape[:axis]).reshape(-1))
+    mean, var, _ = _ln_stats(x, axes)
+    ctx.set_output("Y", _ln_train(x, scale, bias, axis, eps))
+    ctx.set_output("Mean", jax.lax.stop_gradient(
+        mean.reshape(x.shape[:axis]).reshape(-1)))
+    ctx.set_output("Variance", jax.lax.stop_gradient(
+        var.reshape(x.shape[:axis]).reshape(-1)))
 
 
 @register_op("group_norm")
