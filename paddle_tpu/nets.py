@@ -104,6 +104,10 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
             "hidden size %d is not divisible by num_heads %d (reference "
             "nets.py raises here too)" % (d_model, num_heads))
     d_key = d_model // num_heads
+    if keys is queries and values is queries:
+        # self-attention: hand None through so the layer takes its fused
+        # single-matmul QKV projection path
+        keys = values = None
     return multi_head_attention(
         queries, keys, values, attn_bias=None, d_key=d_key, d_value=d_key,
         d_model=d_model, n_head=num_heads, dropout_rate=dropout_rate)
