@@ -474,16 +474,20 @@ def bench_long_context(b=1, h=8, s=8192, d=64):
         return (tmin(make(hi)) - tmin(make(lo))) / (hi - lo) * 1e3
 
     out = {"shape": "b%d h%d s%d d%d bf16 causal" % (b, h, s, d),
-           "note": "gate is a MEMORY gate: composed O(S^2) wins on speed "
-                   "while it fits, OOMs ~24k; flash is O(S) "
+           "note": "gate is a PERF crossover at S=2048: v5e-tuned BlockSizes "
+                   "(512x512, r4 sweep) make flash beat composed above it; "
+                   "flash is also O(S) memory where composed OOMs ~24k "
                    "(FLAGS_flash_attention_min_seq)"}
+    from paddle_tpu.flags import get_flag
+
+    old_gate = get_flag("flash_attention_min_seq")
     set_flag("flash_attention_min_seq", 1)       # force the Pallas kernel
     out["flash_ms"] = round(per_iter_ms(
         lambda t, k_, v_: sdpa(t, k_, v_, causal=True, sm_scale=d ** -0.5)), 2)
     set_flag("flash_attention_min_seq", 10 ** 9)  # force the composed path
     out["composed_ms"] = round(per_iter_ms(
         lambda t, k_, v_: sdpa(t, k_, v_, causal=True, sm_scale=d ** -0.5)), 2)
-    set_flag("flash_attention_min_seq", 8192)     # restore the tuned gate
+    set_flag("flash_attention_min_seq", old_gate)  # restore the tuned gate
     out["flash_speedup"] = round(out["composed_ms"] / out["flash_ms"], 3)
 
     # ring attention, sp=1 (single chip): the ring machinery's overhead vs

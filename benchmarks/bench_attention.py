@@ -7,10 +7,14 @@ Measured 2026-07-30 on v5e (loop-difference timing, causal fwd+bwd):
   r2 (f32 softmax): S=2048 flash 5.22 vs composed 3.32 ms; S=8192 13.41 vs 16.39
   r3 (bf16 softmax): S=8192 flash 11.53 vs composed 4.03 ms;
                      S=16384 flash 96.64 vs composed 59.45 ms
-After the composed path's softmax went dtype-preserving (bf16), XLA wins on
-SPEED at every shape that fits; FLAGS_flash_attention_min_seq is now a
-MEMORY gate (default 24576): the composed O(S²) buffers OOM around S~24k
-single-chip, where flash's O(S) memory is the only viable path.
+  r4 (v5e-tuned BlockSizes 512x512): S=2048 flash 1.24 vs composed 2.00 ms
+     (1.61x); S=4096 1.85 vs 6.40 (3.46x); S=8192 3.12 vs 12.93 (4.15x);
+     S=16384 12.07 vs 39.20 (3.25x). Sweeps: sweep_flash_blocks.py,
+     sweep_flash_crossover.py.
+The stock all-128 BlockSizes were the r3 loss; with 512x512 tiles flash wins
+everywhere above S~2048, so FLAGS_flash_attention_min_seq (default 2048) is
+a PERF crossover, and flash's O(S) memory additionally rescues shapes where
+composed OOMs (~24k single-chip).
 """
 
 import json
@@ -57,8 +61,9 @@ def _per_iter_ms(fn, q, k, v, lo=1, hi=5, reps=4):
 
 
 def main():
-    from paddle_tpu.flags import set_flag
+    from paddle_tpu.flags import get_flag, set_flag
 
+    old_gate = get_flag("flash_attention_min_seq")
     for b, h, s, d in [(4, 8, 2048, 64), (1, 8, 8192, 64)]:
         k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
         q = jax.random.normal(k1, (b, h, s, d), jnp.bfloat16)
@@ -67,7 +72,7 @@ def main():
         set_flag("flash_attention_min_seq", 128)  # force flash for the A side
         tf = _per_iter_ms(lambda t, kk, vv: sdpa(t, kk, vv, causal=True,
                                                  sm_scale=d ** -0.5), q, k, v)
-        set_flag("flash_attention_min_seq", 8192)  # restore the default
+        set_flag("flash_attention_min_seq", old_gate)  # restore the default
         # B side calls the local composed() directly — no gate involved
         tc = _per_iter_ms(lambda t, kk, vv: composed(t, kk, vv, True), q, k, v)
         print(json.dumps({"bench": "attention_fwd_bwd_bf16_causal",

@@ -1,0 +1,97 @@
+"""Find the flash-vs-composed crossover with v5e-tuned BlockSizes.
+
+Sweeps sequence length at the long-context shape (b1 h8 d64 causal) and the
+Transformer-base bench shape (b64 h8 d64 s256), fwd+bwd, bf16.
+"""
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+
+from paddle_tpu.ops.attention_ops import _tuned_block_sizes as tuned_blocks
+
+
+def timeit(fn, args, lo=2, hi=12):
+    def chain(n):
+        @jax.jit
+        def run(q, k, v):
+            def body(c, _):
+                qq, kk2, vv = c
+                g = fn(qq, kk2, vv)
+                return (qq + 1e-6 * g[0].astype(qq.dtype), kk2, vv), g[0][0, 0, 0, 0]
+            _, outs = jax.lax.scan(body, (q, k, v), None, length=n)
+            return outs
+        return run
+    r_lo, r_hi = chain(lo), chain(hi)
+    np.asarray(r_lo(*args)); np.asarray(r_hi(*args))
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter(); np.asarray(r_lo(*args)); t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter(); np.asarray(r_hi(*args)); t_hi = time.perf_counter() - t0
+        best = min(best, (t_hi - t_lo) / (hi - lo))
+    return best * 1e3
+
+
+def grad_of(attn):
+    def loss(q, k, v):
+        return jnp.sum(attn(q, k, v).astype(jnp.float32))
+    return jax.grad(loss, argnums=(0, 1, 2))
+
+
+def make_composed(S, causal):
+    def composed(q, k, v):
+        d = q.shape[-1]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (1.0 / d ** 0.5)
+        if causal:
+            cm = jnp.tril(jnp.ones((S, S), bool))
+            s = jnp.where(cm, s, jnp.full_like(s, -1e9))
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return composed
+
+
+def make_flash(S, causal):
+    bs = tuned_blocks(S, S)
+    def flash(q, k, v):
+        d = q.shape[-1]
+        return fa.flash_attention(q, k, v, causal=causal, sm_scale=1.0 / d ** 0.5,
+                                  block_sizes=bs)
+    return flash
+
+
+out = {}
+shapes = [
+    # (B, H, S, D, causal, label)
+    (64, 8, 256, 64, True, "bench_transformer_b64_s256"),
+    (64, 8, 256, 64, False, "b64_s256_noncausal"),
+    (8, 8, 1024, 64, True, "b8_s1024"),
+    (4, 8, 2048, 64, True, "b4_s2048"),
+    (2, 8, 4096, 64, True, "b2_s4096"),
+    (1, 8, 8192, 64, True, "b1_s8192"),
+    (1, 8, 16384, 64, True, "b1_s16384"),
+    (32, 16, 512, 64, True, "bertish_b32_h16_s512"),
+    (1, 8, 512, 128, True, "b1_s512_d128"),
+]
+for B, H, S, D, causal, label in shapes:
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, H, S, D), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, H, S, D), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, H, S, D), jnp.bfloat16)
+    try:
+        t_c = timeit(grad_of(make_composed(S, causal)), (q, k, v))
+    except Exception as e:
+        t_c = float("nan"); print(label, "composed FAIL", str(e)[:80])
+    try:
+        t_f = timeit(grad_of(make_flash(S, causal)), (q, k, v))
+    except Exception as e:
+        t_f = float("nan"); print(label, "flash FAIL", str(e)[:80])
+    ok = t_c == t_c and t_f == t_f
+    out[label] = {"composed_ms": round(t_c, 3) if t_c == t_c else None,
+                  "flash_ms": round(t_f, 3) if t_f == t_f else None,
+                  "speedup": round(t_c / t_f, 3) if ok else None}
+    print(label, out[label], flush=True)
+
+print(json.dumps(out))

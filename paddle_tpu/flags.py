@@ -20,10 +20,12 @@ _DEFAULTS: Dict[str, Any] = {
     "benchmark": False,              # block_until_ready every step (operator.cc:942)
     "strict_fused_attention": False, # raise (not warn+fallback) if the Pallas
                                      # flash-attention call fails on TPU
-    "flash_attention_min_seq": 24576, # memory gate: composed attention's
-                                     # O(S^2) buffers OOM ~24k on v5e; flash
-                                     # is slower but O(S) (bench_attention.py,
-                                     # r3 re-measurement after bf16 softmax)
+    "flash_attention_min_seq": 2048, # perf crossover: with v5e-tuned
+                                     # BlockSizes (r4 sweep) flash beats
+                                     # composed 1.6x at S=2048 up to 4.2x at
+                                     # S=8192; composed wins below (its single
+                                     # fused HLO beats the kernel's fixed
+                                     # grid overhead at short S)
     "eager_delete_tensor_gb": 0.0,   # accepted; XLA buffer liveness handles it
     # accepted for compatibility, no-ops under XLA
     "fraction_of_gpu_memory_to_use": 0.92,

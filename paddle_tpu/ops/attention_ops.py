@@ -37,17 +37,48 @@ def _on_tpu() -> bool:
     return jax.default_backend() not in ("cpu", "gpu")
 
 
+def _tuned_block_sizes(sq: int, sk: int):
+    """v5e-tuned tile sizes for the Pallas flash kernel.
+
+    The stock ``BlockSizes.get_default()`` is all-128, which loses 0.63x to
+    XLA-composed attention at S=8192 (round-3 finding). A full (block_q x
+    block_k) sweep on the real v5e chip (benchmarks/sweep_flash_blocks.py,
+    round 4) found 512x512 optimal: 2.65 ms vs 17.2 ms default vs 12.8 ms
+    composed at b1 h8 s8192 d64 causal bf16 fwd+bwd — a 4.8x win. Larger
+    tiles amortize the grid/DMA overhead and keep the MXU fed; beyond 512
+    the VMEM working set starts thrashing. Blocks must divide the sequence
+    lengths, so shorter/ragged sequences fall back to the largest divisor.
+    """
+    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+
+    def pick(s):
+        for b in (512, 256, 128):
+            if s % b == 0:
+                return b
+        raise ValueError(
+            "flash-attention sequence length %d is not a multiple of 128 "
+            "(the gate in _flash_ok should have rejected it)" % s)
+
+    bq, bk = pick(sq), pick(sk)
+    return BlockSizes(
+        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
+        block_q_dkv=bq,
+        block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq,
+    )
+
+
 def _flash_ok(q, k, causal) -> bool:
-    """Gates for the Pallas kernel: blocking constraints (seq multiples of
-    128) AND a measured threshold. Round-3 re-measurement on v5e (after the
-    composed path's softmax went dtype-preserving bf16): composed WINS on
-    speed at every shape that fits — S=8192 flash 11.5ms vs composed 4.0ms,
-    S=16384 flash 96.6ms vs composed 59.5ms (b1 h8 d64 causal fwd+bwd,
-    loop-difference timing). The gate is therefore a MEMORY gate, not a
-    speed gate: the composed path materializes O(S²) score buffers
-    (bf16 [b,h,S,S] ≈ 4GB per buffer at S=16k in a real model) and OOMs
-    around S~24k single-chip, where flash's O(S) memory is the only viable
-    path. FLAGS_flash_attention_min_seq tunes the switch per hardware."""
+    """Gate for the Pallas kernel: blocking constraints (seq multiples of
+    128) AND a measured perf crossover. With the v5e-tuned BlockSizes (see
+    _tuned_block_sizes) the round-4 sweep (benchmarks/sweep_flash_crossover.py,
+    b* h8 d64 causal bf16 fwd+bwd, loop-difference timing) measured flash
+    speedup over composed: S=1024 0.80x, S=2048 1.61x, S=4096 3.46x,
+    S=8192 4.15x, S=16384 3.25x. The crossover is ~S=2048, which is the
+    FLAGS_flash_attention_min_seq default; below it the composed path's
+    single fused HLO beats the kernel's fixed grid overhead, above it the
+    O(S) memory AND the tiling win compound. (The composed path OOMs around
+    S~24k single-chip, so flash is also the only viable path there.)"""
     flash, _ = _flash_fn()
     if flash is None or not _on_tpu():
         return False
@@ -73,7 +104,9 @@ def sdpa(q, k, v, bias=None, segment_ids_q=None, segment_ids_kv=None,
         if segment_ids_q is not None:
             seg = SegmentIds(q=segment_ids_q, kv=segment_ids_kv)
         try:
-            return flash(q, k, v, ab=bias, segment_ids=seg, causal=causal, sm_scale=sm_scale)
+            bs = _tuned_block_sizes(q.shape[2], k.shape[2])
+            return flash(q, k, v, ab=bias, segment_ids=seg, causal=causal,
+                         sm_scale=sm_scale, block_sizes=bs)
         except Exception as e:
             # A failed flash call means a ~S² perf regression — never hide it.
             from ..flags import get_flag

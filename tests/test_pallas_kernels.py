@@ -86,11 +86,12 @@ def _mk_qkv(rng, s=256, d=64):
 @pytest.fixture
 def _flash_any_seq():
     """Lower the profitability threshold so small test shapes take flash."""
-    from paddle_tpu.flags import set_flag
+    from paddle_tpu.flags import get_flag, set_flag
 
+    old = get_flag("flash_attention_min_seq")
     set_flag("flash_attention_min_seq", 128)
     yield
-    set_flag("flash_attention_min_seq", 8192)
+    set_flag("flash_attention_min_seq", old)
 
 
 def test_flash_failure_warns_not_silent(rng, monkeypatch, _flash_any_seq):
@@ -130,8 +131,10 @@ def test_flash_path_taken_when_gates_pass(rng, monkeypatch, _flash_any_seq):
     q, k, v = _mk_qkv(rng)
     called = {}
 
-    def fake_flash(q, k, v, ab=None, segment_ids=None, causal=False, sm_scale=1.0):
+    def fake_flash(q, k, v, ab=None, segment_ids=None, causal=False,
+                   sm_scale=1.0, block_sizes=None):
         called["yes"] = True
+        called["block_sizes"] = block_sizes
         return q
 
     monkeypatch.setattr(attention_ops, "_on_tpu", lambda: True)
@@ -149,10 +152,25 @@ def test_flash_gate_rejects_causal_rectangular(rng, monkeypatch, _flash_any_seq)
 
 
 def test_flash_gate_profitability_threshold(rng, monkeypatch):
-    """Below the measured crossover the composed path must win the gate."""
+    """Below the measured crossover (S=2048 with v5e-tuned BlockSizes, r4
+    sweep) the composed path must win the gate; at/above it flash must."""
     monkeypatch.setattr(attention_ops, "_on_tpu", lambda: True)
     monkeypatch.setattr(attention_ops, "_flash_fn", lambda: (lambda *a, **k: None, None))
-    q = jnp.zeros((2, 4, 2048, 64))
+    q = jnp.zeros((2, 4, 1024, 64))
     assert not attention_ops._flash_ok(q, q, causal=False)
+    q2 = jnp.zeros((2, 4, 2048, 64))
+    assert attention_ops._flash_ok(q2, q2, causal=False)
     q8 = jnp.zeros((1, 4, 8192, 64))
     assert attention_ops._flash_ok(q8, q8, causal=False)
+
+
+def test_tuned_block_sizes():
+    """v5e tuning: 512x512 tiles when the sequence allows, largest divisor
+    otherwise (blocks must divide the sequence lengths)."""
+    bs = attention_ops._tuned_block_sizes(8192, 8192)
+    assert bs.block_q == 512 and bs.block_k == 512
+    assert bs.block_q_dkv == 512 and bs.block_k_major_dq == 512
+    bs = attention_ops._tuned_block_sizes(2048, 2048)
+    assert bs.block_q == 512
+    bs = attention_ops._tuned_block_sizes(384, 2048)
+    assert bs.block_q == 128 and bs.block_k == 512  # 384 = 3*128
