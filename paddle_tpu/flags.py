@@ -26,6 +26,10 @@ _DEFAULTS: Dict[str, Any] = {
                                      # S=8192; composed wins below (its single
                                      # fused HLO beats the kernel's fixed
                                      # grid overhead at short S)
+    "ring_flash_min_block": 2048,    # ring attention: local shard length at
+                                     # which the per-block compute switches
+                                     # from composed to the Pallas flash
+                                     # kernel (same crossover as above)
     "eager_delete_tensor_gb": 0.0,   # accepted; XLA buffer liveness handles it
     # accepted for compatibility, no-ops under XLA
     "fraction_of_gpu_memory_to_use": 0.92,

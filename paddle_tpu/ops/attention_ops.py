@@ -37,6 +37,17 @@ def _on_tpu() -> bool:
     return jax.default_backend() not in ("cpu", "gpu")
 
 
+def _pick_block(s: int):
+    """Largest v5e-tuned tile (512 optimal, r4 sweep) that divides ``s``.
+    Single source of truth for both sdpa and ring-attention block compute."""
+    for b in (512, 256, 128):
+        if s % b == 0:
+            return b
+    raise ValueError(
+        "flash-attention sequence length %d is not a multiple of 128 "
+        "(the caller's gate should have rejected it)" % s)
+
+
 def _tuned_block_sizes(sq: int, sk: int):
     """v5e-tuned tile sizes for the Pallas flash kernel.
 
@@ -51,15 +62,7 @@ def _tuned_block_sizes(sq: int, sk: int):
     """
     from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
 
-    def pick(s):
-        for b in (512, 256, 128):
-            if s % b == 0:
-                return b
-        raise ValueError(
-            "flash-attention sequence length %d is not a multiple of 128 "
-            "(the gate in _flash_ok should have rejected it)" % s)
-
-    bq, bk = pick(sq), pick(sk)
+    bq, bk = _pick_block(sq), _pick_block(sk)
     return BlockSizes(
         block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
         block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,

@@ -89,3 +89,49 @@ def test_ring_attention_op_fallback_without_sp(qkv):
     want = sdpa(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True,
                 sm_scale=scale)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_ring_flash_block_gate(monkeypatch):
+    """Flash blocks only on TPU, 128-aligned shards, above the crossover."""
+    import importlib
+
+    ra = importlib.import_module("paddle_tpu.parallel.ring_attention")
+
+    q32 = jnp.zeros((1, 2, 4096, 64), jnp.float32)
+    # off-TPU: never
+    assert not ra._use_flash_blocks(q32, 4096)
+    monkeypatch.setattr("paddle_tpu.ops.attention_ops._on_tpu", lambda: True)
+    if ra._block_sizes_for(4096):
+        from paddle_tpu.ops.attention_ops import _flash_fn
+
+        if _flash_fn()[0] is not None:
+            assert ra._use_flash_blocks(q32, 4096)
+            assert not ra._use_flash_blocks(q32, 1024)   # below crossover
+            assert not ra._use_flash_blocks(q32, 2100)   # not 128-aligned
+            qi = jnp.zeros((1, 2, 4096, 64), jnp.int32)
+            assert not ra._use_flash_blocks(qi, 4096)    # wrong dtype
+
+
+def test_ring_blockwise_residuals_are_linear_in_s():
+    """The custom VJP must not save per-step score blocks: residuals are
+    (q, k, v, out, lse) only — O(S_local), not O(S_local^2)."""
+    from paddle_tpu.parallel.ring_attention import _ring_blockwise_fwd
+
+    b, h, s, d = 1, 2, 64, 16
+    q = jnp.ones((b, h, s, d), jnp.float32)
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(4), ("sp",))
+
+    def local(q, k, v):
+        return _ring_blockwise_fwd("sp", True, 0.25, False, q, k, v)
+
+    out, res = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(None, None, "sp", None),) * 3,
+        out_specs=(P(None, None, "sp", None),
+                   (P(None, None, "sp", None),) * 4 + (P(None, None, "sp"),)),
+        check_vma=False)(q, q, q)
+    assert out.shape == q.shape
+    q_r, k_r, v_r, out_r, lse_r = res
+    assert lse_r.shape == (b, h, s)          # O(S) softmax stats
+    for r in (q_r, k_r, v_r, out_r):
+        assert r.shape == q.shape            # no [*, S, S] buffer saved
