@@ -65,13 +65,24 @@ def _transformer_train_flops_per_example(seq, vocab, n_layer=6, d_model=512,
 _RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.1e9  # ~4.1 GFLOP fwd @224²
 
 
-def _device_feed(feed):
+def _device_feed(feed, mesh=None):
     """Pre-place feed arrays in HBM once — the benchmark measures the train
     step, not host→device (or tunnel) transfer of identical data every
-    iteration. The executor keeps jax.Arrays as-is (no host round-trip)."""
+    iteration. The executor keeps jax.Arrays as-is (no host round-trip).
+    With ``mesh``, arrays are pre-sharded batch-major over the ``data`` axis
+    so the N-device run doesn't pay a growing H2D transfer per step either
+    (which would systematically understate scaling efficiency)."""
     import jax
 
-    return {k: jax.device_put(v) for k, v in feed.items()}
+    if mesh is None:
+        return {k: jax.device_put(v) for k, v in feed.items()}
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def put(v):
+        spec = P("data", *([None] * (v.ndim - 1)))
+        return jax.device_put(v, NamedSharding(mesh, spec))
+
+    return {k: put(v) for k, v in feed.items()}
 
 
 def _timeit(run_step, batch, skip=5, iters=20):
@@ -94,7 +105,10 @@ def _timeit(run_step, batch, skip=5, iters=20):
 # -- paddle_tpu benches -------------------------------------------------------
 
 
-def bench_transformer(batch=64, seq=256, vocab=30000, use_amp=True):
+def bench_transformer(batch=64, seq=256, vocab=30000, use_amp=True,
+                      n_devices=None, skip=5, iters=20):
+    """``n_devices``: run through CompiledProgram.with_mesh({'data': n}) —
+    the GSPMD data-parallel path — with ``batch`` as the GLOBAL batch."""
     import paddle_tpu as fluid
     from paddle_tpu.models import transformer as tfm
 
@@ -118,24 +132,35 @@ def bench_transformer(batch=64, seq=256, vocab=30000, use_amp=True):
             exe = fluid.Executor(fluid.TPUPlace(0))
             exe.run(startup)
 
+            prog = main_prog
+            mesh = None
+            if n_devices:
+                from paddle_tpu.parallel.mesh import create_mesh
+
+                mesh = create_mesh({"data": n_devices})
+                prog = fluid.CompiledProgram(main_prog).with_mesh(
+                    mesh, loss_name=loss.name)
+
             rng = np.random.RandomState(0)
-            feed = _device_feed({
+            feed = {
                 "src": rng.randint(2, vocab, (batch, seq)).astype("int64"),
                 "trg": rng.randint(2, vocab, (batch, seq)).astype("int64"),
                 "lbl": rng.randint(2, vocab, (batch, seq, 1)).astype("int64"),
                 "smask": np.ones((batch, seq), "float32"),
                 "tmask": np.ones((batch, seq), "float32"),
-            })
+            }
+            feed = _device_feed(feed, mesh)
 
             def step():
-                lv, = exe.run(main_prog, feed=feed, fetch_list=[loss],
+                lv, = exe.run(prog, feed=feed, fetch_list=[loss],
                               return_numpy=False)
                 return lv
 
-            return _timeit(step, batch)
+            return _timeit(step, batch, skip=skip, iters=iters)
 
 
-def bench_resnet50(batch=64, image=224, classes=1000, use_amp=True):
+def bench_resnet50(batch=64, image=224, classes=1000, use_amp=True,
+                   n_devices=None, skip=5, iters=20):
     import paddle_tpu as fluid
     from paddle_tpu.models import resnet as rn
 
@@ -153,18 +178,29 @@ def bench_resnet50(batch=64, image=224, classes=1000, use_amp=True):
 
             exe = fluid.Executor(fluid.TPUPlace(0))
             exe.run(startup)
+
+            prog = main_prog
+            mesh = None
+            if n_devices:
+                from paddle_tpu.parallel.mesh import create_mesh
+
+                mesh = create_mesh({"data": n_devices})
+                prog = fluid.CompiledProgram(main_prog).with_mesh(
+                    mesh, loss_name=loss.name)
+
             rng = np.random.RandomState(0)
-            feed = _device_feed({
+            feed = {
                 "img": rng.randn(batch, 3, image, image).astype("float32"),
                 "label": rng.randint(0, classes, (batch, 1)).astype("int64"),
-            })
+            }
+            feed = _device_feed(feed, mesh)
 
             def step():
-                lv, = exe.run(main_prog, feed=feed, fetch_list=[loss],
+                lv, = exe.run(prog, feed=feed, fetch_list=[loss],
                               return_numpy=False)
                 return lv
 
-            return _timeit(step, batch)
+            return _timeit(step, batch, skip=skip, iters=iters)
 
 
 # -- raw-JAX yardsticks -------------------------------------------------------
@@ -449,25 +485,28 @@ def bench_long_context(b=1, h=8, s=8192, d=64):
     kk = jax.random.normal(k2, (b, h, s, d), jnp.float32).astype(jnp.bfloat16)
     v = jax.random.normal(k3, (b, h, s, d), jnp.float32).astype(jnp.bfloat16)
 
-    def per_iter_ms(fn, lo=2, hi=10, reps=4):
+    def per_iter_ms(fn, lo=8, hi=64, reps=3):
+        # wide spread: ~4ms/iter kernels need the hi-chain to run ~0.25s or
+        # the axon tunnel's per-call jitter (~±10ms) swamps the difference
         def make(iters):
-            def body(i, carry):
-                qq, acc = carry
+            @jax.jit
+            def run(qq0):
+                def body(c, _):
+                    g = jax.grad(
+                        lambda t: jnp.sum(fn(t, kk, v).astype(jnp.float32) ** 2))(c)
+                    return c + 1e-6 * g.astype(c.dtype), g[0, 0, 0, 0]
 
-                def loss(t):
-                    return jnp.sum(fn(t, kk, v).astype(jnp.float32) ** 2)
+                _, o = jax.lax.scan(body, qq0, None, length=iters)
+                return o
 
-                l, g = jax.value_and_grad(loss)(qq)
-                return qq + 1e-6 * g.astype(qq.dtype), acc + l
-
-            return jax.jit(lambda: jax.lax.fori_loop(0, iters, body, (q, 0.0))[1])
+            return run
 
         def tmin(f):
-            float(f())
+            np.asarray(f(q))
             ts = []
             for _ in range(reps):
                 t0 = time.perf_counter()
-                float(f())
+                np.asarray(f(q))
                 ts.append(time.perf_counter() - t0)
             return min(ts)
 
@@ -504,7 +543,89 @@ def bench_long_context(b=1, h=8, s=8192, d=64):
     return out
 
 
+def bench_scaling(axes_str="data=8"):
+    """1→N chip scaling harness — the BASELINE.json north-star metric
+    ("train step/sec + scaling eff 1→8 chips") as one command:
+
+        python bench.py --mesh data=8
+
+    Runs the SAME per-chip workload on a 1-device and an N-device ``data``
+    mesh through CompiledProgram.with_mesh (the GSPMD path: feeds shard over
+    the data axis, XLA inserts the gradient all-reduce over ICI) and reports
+    per-chip examples/sec + scaling efficiency = eps_N / (N * eps_1).
+
+    On CPU — the only multi-device option in this environment — it validates
+    the identical code path with tiny shapes and labels results
+    ``cpu-dryrun``; numbers there measure host contention, not ICI, and are
+    NOT performance evidence. On a real v5e-8 the same command is the
+    production measurement.
+    """
+    import os
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the axon sitecustomize pre-imports jax with the TPU plugin; drop
+        # any initialized backend so the CPU dryrun settings take effect
+        # (same dance as tests/conftest.py), and make sure the virtual
+        # device count is set BEFORE the backend re-initializes
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        jax.config.update("jax_platforms", "cpu")
+        from jax._src import xla_bridge as _xb
+
+        if _xb.backends_are_initialized():
+            _xb._clear_backends()
+
+    axes = {}
+    for part in axes_str.split(","):
+        k, v = part.split("=")
+        axes[k.strip()] = int(v)
+    n = int(np.prod(list(axes.values())))
+    avail = len(jax.devices())
+    if avail < n:
+        return {"error": "mesh %s needs %d devices, have %d" % (axes, n, avail)}
+    dryrun = jax.default_backend() == "cpu"
+    if dryrun:
+        tfm_kw = dict(seq=64, vocab=1000, skip=2, iters=5)
+        rn_kw = dict(image=64, classes=100, skip=2, iters=5)
+        tb, rb = 4, 4          # per-chip batches
+    else:
+        tfm_kw = dict(seq=256, vocab=30000)
+        rn_kw = dict(image=224, classes=1000)
+        tb, rb = 64, 64
+
+    out = {"mode": "cpu-dryrun" if dryrun else "tpu", "mesh": axes,
+           "n_devices": n}
+    for name, fn, b, kw in (("transformer", bench_transformer, tb, tfm_kw),
+                            ("resnet50", bench_resnet50, rb, rn_kw)):
+        eps1, _ = fn(batch=b, n_devices=1, **kw)
+        epsn, _ = fn(batch=b * n, n_devices=n, **kw)
+        out[name] = {
+            "per_chip_batch": b,
+            "examples_per_sec_1dev": round(eps1, 2),
+            "examples_per_sec_%ddev" % n: round(epsn, 2),
+            "per_chip_examples_per_sec": round(epsn / n, 2),
+            "scaling_efficiency": round(epsn / (n * eps1), 4),
+        }
+    return out
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--mesh":
+        if len(sys.argv) < 3:
+            print(json.dumps({"error": "usage: bench.py --mesh data=8"}))
+            sys.exit(2)
+        res = bench_scaling(sys.argv[2])
+        eff = res.get("transformer", {}).get("scaling_efficiency")
+        print(json.dumps({
+            "metric": "scaling_efficiency_1_to_%d" % res.get("n_devices", 0),
+            "value": eff, "unit": "ratio", "vs_baseline": eff,
+            "detail": res}))
+        return
+
     peak, kind = _device_peak_flops()
     detail = {"device": kind}
 

@@ -66,3 +66,25 @@ def test_data_parallel_feed_actually_sharded(rng):
     vals = exe.run(prog, feed={"x": xs}, fetch_list=[out], return_numpy=False)
     # output stays sharded on the batch axis across all 8 devices
     assert len(vals[0].sharding.device_set) == 8
+
+
+def test_bench_scaling_harness_path():
+    """The 1→N scaling harness (bench.py --mesh data=N) must run end-to-end
+    on the virtual mesh: program compiles over the data mesh, feed shards,
+    and the efficiency arithmetic is well-formed. (CPU numbers are labeled
+    cpu-dryrun and are not performance evidence.)"""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    eps1, sps1 = bench.bench_transformer(batch=2, seq=16, vocab=64,
+                                         n_devices=1, skip=1, iters=2)
+    epsn, spsn = bench.bench_transformer(batch=8, seq=16, vocab=64,
+                                         n_devices=4, skip=1, iters=2)
+    assert eps1 > 0 and epsn > 0
+    assert np.isfinite(epsn / (4 * eps1))
