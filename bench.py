@@ -65,6 +65,17 @@ def _transformer_train_flops_per_example(seq, vocab, n_layer=6, d_model=512,
 _RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.1e9  # ~4.1 GFLOP fwd @224²
 
 
+def _mesh_prog(fluid, main_prog, loss, n_devices):
+    """(program-to-run, mesh) — data-mesh CompiledProgram when requested."""
+    if not n_devices:
+        return main_prog, None
+    from paddle_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh({"data": n_devices})
+    prog = fluid.CompiledProgram(main_prog).with_mesh(mesh, loss_name=loss.name)
+    return prog, mesh
+
+
 def _device_feed(feed, mesh=None):
     """Pre-place feed arrays in HBM once — the benchmark measures the train
     step, not host→device (or tunnel) transfer of identical data every
@@ -132,14 +143,7 @@ def bench_transformer(batch=64, seq=256, vocab=30000, use_amp=True,
             exe = fluid.Executor(fluid.TPUPlace(0))
             exe.run(startup)
 
-            prog = main_prog
-            mesh = None
-            if n_devices:
-                from paddle_tpu.parallel.mesh import create_mesh
-
-                mesh = create_mesh({"data": n_devices})
-                prog = fluid.CompiledProgram(main_prog).with_mesh(
-                    mesh, loss_name=loss.name)
+            prog, mesh = _mesh_prog(fluid, main_prog, loss, n_devices)
 
             rng = np.random.RandomState(0)
             feed = {
@@ -179,14 +183,7 @@ def bench_resnet50(batch=64, image=224, classes=1000, use_amp=True,
             exe = fluid.Executor(fluid.TPUPlace(0))
             exe.run(startup)
 
-            prog = main_prog
-            mesh = None
-            if n_devices:
-                from paddle_tpu.parallel.mesh import create_mesh
-
-                mesh = create_mesh({"data": n_devices})
-                prog = fluid.CompiledProgram(main_prog).with_mesh(
-                    mesh, loss_name=loss.name)
+            prog, mesh = _mesh_prog(fluid, main_prog, loss, n_devices)
 
             rng = np.random.RandomState(0)
             feed = {
@@ -583,7 +580,12 @@ def bench_scaling(axes_str="data=8"):
     for part in axes_str.split(","):
         k, v = part.split("=")
         axes[k.strip()] = int(v)
-    n = int(np.prod(list(axes.values())))
+    if list(axes) != ["data"] or axes["data"] < 1:
+        # the harness measures DATA-parallel scaling (the north-star
+        # metric); tp/pp/sp/ep live in dryrun_multichip, not here
+        return {"error": "only --mesh data=N (N>=1) is supported, got %r"
+                % axes_str}
+    n = axes["data"]
     avail = len(jax.devices())
     if avail < n:
         return {"error": "mesh %s needs %d devices, have %d" % (axes, n, avail)}
@@ -619,6 +621,9 @@ def main():
             print(json.dumps({"error": "usage: bench.py --mesh data=8"}))
             sys.exit(2)
         res = bench_scaling(sys.argv[2])
+        if "error" in res:
+            print(json.dumps(res))
+            sys.exit(1)
         eff = res.get("transformer", {}).get("scaling_efficiency")
         print(json.dumps({
             "metric": "scaling_efficiency_1_to_%d" % res.get("n_devices", 0),

@@ -202,3 +202,46 @@ def precision_recall_op(ctx: OpContext):
     ctx.set_output("BatchMetrics", metrics(batch_states))
     ctx.set_output("AccumMetrics", metrics(accum))
     ctx.set_output("AccumStatesInfo", accum)
+
+
+@register_op("positive_negative_pair")
+def positive_negative_pair_op(ctx: OpContext):
+    """Ranking-pair metric (reference: operators/positive_negative_pair_op.h).
+
+    For every within-query document pair with different labels: a pair is
+    positive when score order agrees with label order, negative when it
+    disagrees, neutral on a score tie (the reference's own python oracle,
+    test_positive_negative_pair_op.py:44, counts ties as neutral ONLY; its
+    C++ kernel also bumps `negative` on a tie — we follow the oracle).
+
+    TPU-first: the reference buckets rows into per-query hash-map lists and
+    walks pair combinations on the host; here the whole thing is one dense
+    [N, N] pairwise mask reduction (same-query ∧ label-differs ∧ upper
+    triangle) — O(N²) elementwise on the VPU, no host loops, jit-safe.
+    """
+    score = ctx.input("Score")
+    label = ctx.input("Label").reshape(-1).astype(jnp.float32)
+    query = ctx.input("QueryID").reshape(-1)
+    weight = ctx.input("Weight")
+    col = int(ctx.attr("column", -1))
+    s = score[:, col].astype(jnp.float32)
+    n = s.shape[0]
+    w = (jnp.ones((n,), jnp.float32) if weight is None
+         else weight.reshape(-1).astype(jnp.float32))
+
+    upper = jnp.triu(jnp.ones((n, n), bool), k=1)
+    valid = upper & (query[:, None] == query[None, :]) \
+        & (label[:, None] != label[None, :])
+    pair_w = jnp.where(valid, (w[:, None] + w[None, :]) * 0.5, 0.0)
+    prod = (s[:, None] - s[None, :]) * (label[:, None] - label[None, :])
+    tie = s[:, None] == s[None, :]
+    pos = jnp.sum(jnp.where(~tie & (prod > 0), pair_w, 0.0))
+    neg = jnp.sum(jnp.where(~tie & (prod <= 0), pair_w, 0.0))
+    neu = jnp.sum(jnp.where(tie, pair_w, 0.0))
+
+    for nm, base in (("PositivePair", pos), ("NegativePair", neg),
+                     ("NeutralPair", neu)):
+        acc = ctx.input("Accumulate" + nm)
+        if acc is not None:
+            base = base + acc.reshape(()).astype(jnp.float32)
+        ctx.set_output(nm, base.reshape(1))

@@ -225,6 +225,61 @@ def rmsprop_op(ctx: OpContext):
     ctx.set_output("MomentOut", mom_new)
 
 
+def _soft_threshold(prox, lr, l1, l2):
+    """The proximal-operator shrinkage shared by proximal_gd/adagrad
+    (reference: operators/optimizers/proximal_gd_op.h:49): L1 soft-threshold
+    then L2 shrink. l1/l2 are static attrs, so the branch folds at trace."""
+    if l1 > 0:
+        return (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+                / (1.0 + lr * l2))
+    return prox / (1.0 + lr * l2)
+
+
+@register_op("proximal_gd")
+def proximal_gd_op(ctx: OpContext):
+    """reference: operators/optimizers/proximal_gd_op.cc (dense-only there;
+    the sparse rows-only variant here matches sgd's SelectedRows idiom)."""
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    lr = _lr(ctx).astype(p.dtype)
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    sg = _sparse(g)
+    if sg is not None:
+        from ..core.sparse import merge_rows
+
+        uniq, merged = merge_rows(sg.ids, sg.rows.astype(p.dtype), p.shape[0])
+        prox_rows = p[uniq] - lr * merged
+        ctx.set_output("ParamOut",
+                       p.at[uniq].set(_soft_threshold(prox_rows, lr, l1, l2)))
+        return
+    prox = p - lr * g.astype(p.dtype)
+    ctx.set_output("ParamOut", _soft_threshold(prox, lr, l1, l2))
+
+
+@register_op("proximal_adagrad")
+def proximal_adagrad_op(ctx: OpContext):
+    """reference: operators/optimizers/proximal_adagrad_op.h:30."""
+    p, g, moment = ctx.input("Param"), ctx.input("Grad"), ctx.input("Moment")
+    lr = _lr(ctx).astype(p.dtype)
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    sg = _sparse(g)
+    if sg is not None:
+        from ..core.sparse import merge_rows
+
+        uniq, merged = merge_rows(sg.ids, sg.rows.astype(p.dtype), p.shape[0])
+        m_rows = moment[uniq] + jnp.square(merged)
+        prox_rows = p[uniq] - lr * merged / jnp.sqrt(m_rows)
+        ctx.set_output("ParamOut",
+                       p.at[uniq].set(_soft_threshold(prox_rows, lr, l1, l2)))
+        ctx.set_output("MomentOut", moment.at[uniq].set(m_rows))
+        return
+    m_new = moment + jnp.square(g.astype(p.dtype))
+    prox = p - lr * g.astype(p.dtype) / jnp.sqrt(m_new)
+    ctx.set_output("ParamOut", _soft_threshold(prox, lr, l1, l2))
+    ctx.set_output("MomentOut", m_new)
+
+
 @register_op("ftrl")
 def ftrl_op(ctx: OpContext):
     p, g = ctx.input("Param"), ctx.input("Grad")

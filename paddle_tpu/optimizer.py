@@ -31,6 +31,8 @@ __all__ = [
     "Ftrl",
     "Lamb",
     "LarsMomentum",
+    "ProximalGD",
+    "ProximalAdagrad",
     "SGDOptimizer",
     "MomentumOptimizer",
     "AdagradOptimizer",
@@ -42,6 +44,8 @@ __all__ = [
     "FtrlOptimizer",
     "LambOptimizer",
     "LarsMomentumOptimizer",
+    "ProximalGDOptimizer",
+    "ProximalAdagradOptimizer",
     "ModelAverage",
     "Optimizer",
 ]
@@ -512,6 +516,59 @@ class FtrlOptimizer(Optimizer):
         )
 
 
+class ProximalGDOptimizer(Optimizer):
+    """Proximal gradient descent with L1/L2 shrinkage (reference op:
+    operators/optimizers/proximal_gd_op.cc; the reference's v1.3 Python
+    layer never exposed it — this class completes the surface the same way
+    the C++ op intended)."""
+
+    type = "proximal_gd"
+
+    def __init__(self, learning_rate, l1_regularization_strength=0.0,
+                 l2_regularization_strength=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1 = l1_regularization_strength
+        self._l2 = l2_regularization_strength
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "proximal_gd",
+            inputs={"Param": p, "Grad": g, "LearningRate": self._lr_input(p)},
+            outputs={"ParamOut": p},
+            attrs={"l1": self._l1, "l2": self._l2},
+        )
+
+
+class ProximalAdagradOptimizer(Optimizer):
+    """reference op: operators/optimizers/proximal_adagrad_op.cc."""
+
+    type = "proximal_adagrad"
+
+    def __init__(self, learning_rate, initial_accumulator_value=0.1,
+                 l1_regularization_strength=0.0,
+                 l2_regularization_strength=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._initial = initial_accumulator_value
+        self._l1 = l1_regularization_strength
+        self._l2 = l2_regularization_strength
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p, fill_value=self._initial)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        moment = self._get_accumulator("moment", p)
+        return block.append_op(
+            "proximal_adagrad",
+            inputs={"Param": p, "Grad": g, "Moment": moment,
+                    "LearningRate": self._lr_input(p)},
+            outputs={"ParamOut": p, "MomentOut": moment},
+            attrs={"l1": self._l1, "l2": self._l2},
+        )
+
+
 class LambOptimizer(AdamOptimizer):
     type = "lamb"
 
@@ -624,3 +681,5 @@ RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
 Lamb = LambOptimizer
 LarsMomentum = LarsMomentumOptimizer
+ProximalGD = ProximalGDOptimizer
+ProximalAdagrad = ProximalAdagradOptimizer

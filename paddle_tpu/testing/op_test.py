@@ -31,16 +31,23 @@ def _canon_inputs(inputs: Dict[str, InputSpec]):
     """Normalize {slot: array | [(name, array), ...]} → (slot_map, env)."""
     slot_map: Dict[str, List[str]] = {}
     env: Dict[str, Any] = {}
+    from ..core.sparse import SparseGrad
+
+    def as_value(v):
+        if isinstance(v, SparseGrad):  # sparse-optimizer variants under test
+            return SparseGrad(ids=jnp.asarray(v.ids), rows=jnp.asarray(v.rows))
+        return jnp.asarray(v)
+
     for slot, spec in (inputs or {}).items():
         if isinstance(spec, list) and spec and isinstance(spec[0], tuple):
             names = []
             for name, arr in spec:
-                env[name] = jnp.asarray(arr)
+                env[name] = as_value(arr)
                 names.append(name)
             slot_map[slot] = names
         else:
             name = "%s@in" % slot
-            env[name] = jnp.asarray(spec)
+            env[name] = as_value(spec)
             slot_map[slot] = [name]
     return slot_map, env
 

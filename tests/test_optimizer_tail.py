@@ -1,0 +1,168 @@
+"""Round-4 op tail: proximal_gd / proximal_adagrad (reference:
+operators/optimizers/proximal_gd_op.h, proximal_adagrad_op.h) and
+positive_negative_pair (reference: operators/positive_negative_pair_op.h),
+checked OpTest-style against numpy oracles ported from the reference's own
+unit tests (test_proximal_gd_op.py, test_positive_negative_pair_op.py)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.testing.op_test import check_output, run_op
+
+
+@pytest.fixture
+def r():
+    return np.random.RandomState(7)
+
+
+def _soft(prox, lr, l1, l2):
+    if l1 > 0:
+        return (np.sign(prox) * np.maximum(np.abs(prox) - lr * l1, 0.0)
+                / (1.0 + lr * l2))
+    return prox / (1.0 + lr * l2)
+
+
+@pytest.mark.parametrize("l1,l2", [(0.0, 0.0), (0.1, 0.2), (0.3, 0.0)])
+def test_proximal_gd(r, l1, l2):
+    p = r.randn(5, 3).astype("float32")
+    g = r.randn(5, 3).astype("float32")
+    lr = np.array([0.05], "float32")
+    want = _soft(p - 0.05 * g, 0.05, l1, l2).astype("float32")
+    check_output("proximal_gd",
+                 {"Param": p, "Grad": g, "LearningRate": lr},
+                 {"ParamOut": want}, attrs={"l1": l1, "l2": l2}, atol=1e-6)
+
+
+@pytest.mark.parametrize("l1,l2", [(0.0, 0.0), (0.1, 0.2)])
+def test_proximal_adagrad(r, l1, l2):
+    p = r.randn(4, 2).astype("float32")
+    g = r.randn(4, 2).astype("float32")
+    m = np.abs(r.randn(4, 2)).astype("float32") + 0.1
+    lr = np.array([0.05], "float32")
+    m_new = m + g * g
+    want = _soft(p - 0.05 * g / np.sqrt(m_new), 0.05, l1, l2).astype("float32")
+    check_output("proximal_adagrad",
+                 {"Param": p, "Grad": g, "Moment": m, "LearningRate": lr},
+                 {"ParamOut": want, "MomentOut": m_new},
+                 attrs={"l1": l1, "l2": l2}, atol=1e-6)
+
+
+def test_proximal_adagrad_sparse_rows_only(r):
+    """The sparse variant must update exactly the touched rows."""
+    from paddle_tpu.core.sparse import SparseGrad
+
+    vocab, dim = 10, 4
+    p = r.randn(vocab, dim).astype("float32")
+    m = np.abs(r.randn(vocab, dim)).astype("float32") + 0.1
+    ids = np.array([2, 7, 2], "int64")          # duplicate id accumulates
+    rows = r.randn(3, dim).astype("float32")
+    lr = np.array([0.1], "float32")
+
+    g = SparseGrad(ids=np.asarray(ids), rows=np.asarray(rows))
+    out = run_op("proximal_adagrad",
+                 {"Param": p, "Grad": g, "Moment": m, "LearningRate": lr},
+                 ["ParamOut", "MomentOut"], attrs={"l1": 0.1, "l2": 0.05})
+
+    dense_g = np.zeros_like(p)
+    np.add.at(dense_g, ids, rows)
+    m_new = m.copy()
+    want = p.copy()
+    for i in np.unique(ids):
+        m_new[i] = m[i] + dense_g[i] ** 2
+        prox = p[i] - 0.1 * dense_g[i] / np.sqrt(m_new[i])
+        want[i] = _soft(prox, 0.1, 0.1, 0.05)
+    np.testing.assert_allclose(np.asarray(out["ParamOut"]), want, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["MomentOut"]), m_new, atol=1e-5)
+    # untouched rows identical
+    untouched = [i for i in range(vocab) if i not in ids]
+    np.testing.assert_array_equal(
+        np.asarray(out["ParamOut"])[untouched], p[untouched])
+
+
+def test_proximal_optimizers_end_to_end(r):
+    """Both optimizers minimize a separable toy problem; L1 drives some
+    weights exactly to zero (the point of the proximal step)."""
+    for make in (lambda: fluid.optimizer.ProximalGD(
+                     0.5, l1_regularization_strength=0.01),
+                 lambda: fluid.optimizer.ProximalAdagrad(
+                     0.5, l1_regularization_strength=0.01)):
+        with fluid.unique_name.guard(), fluid.scope_guard(fluid.core.Scope()):
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data("x", shape=[8])
+                y = fluid.layers.data("y", shape=[1])
+                pred = fluid.layers.fc(x, size=1)
+                loss = fluid.layers.mean(fluid.layers.square(pred - y))
+                make().minimize(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            xs = r.randn(64, 8).astype("float32")
+            # only the first feature matters -> L1 should zero the rest
+            ys = (2.0 * xs[:, :1]).astype("float32")
+            losses = [float(exe.run(main, feed={"x": xs, "y": ys},
+                                    fetch_list=[loss])[0]) for _ in range(40)]
+            assert losses[-1] < losses[0] * 0.2, losses
+
+
+def _pnpair_oracle(score, label, query, column=-1, weight=None):
+    """Ported from the reference's own oracle
+    (tests/unittests/test_positive_negative_pair_op.py:24)."""
+    predictions = {}
+    n = label.shape[0]
+    if weight is None:
+        weight = np.ones((n, 1), "float32")
+    for s, l, q, w in zip(score, label, query, weight):
+        predictions.setdefault(q[0], []).append((s[column], l[0], w[0]))
+    pos = neg = neu = 0.0
+    for ranks in predictions.values():
+        for e1, e2 in itertools.combinations(ranks, 2):
+            (s1, l1, w1), (s2, l2, w2) = e1, e2
+            if l1 == l2:
+                continue
+            w = (w1 + w2) * 0.5
+            if s1 == s2:
+                neu += w
+            elif (s1 - s2) * (l1 - l2) > 0:
+                pos += w
+            else:
+                neg += w
+    return pos, neg, neu
+
+
+def test_positive_negative_pair(r):
+    n, width, n_query = 24, 3, 4
+    score = r.rand(n, width).astype("float32")
+    label = r.randint(0, 3, (n, 1)).astype("float32")
+    query = np.asarray([[i % n_query] for i in range(n)], "int64")
+    pos, neg, neu = _pnpair_oracle(score, label, query)
+    out = run_op("positive_negative_pair",
+                 {"Score": score, "Label": label, "QueryID": query},
+                 ["PositivePair", "NegativePair", "NeutralPair"])
+    np.testing.assert_allclose(np.asarray(out["PositivePair"]), [pos], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["NegativePair"]), [neg], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["NeutralPair"]), [neu], atol=1e-6)
+
+
+def test_positive_negative_pair_weighted_ties_accum(r):
+    n = 12
+    score = np.round(r.rand(n, 2), 1).astype("float32")  # force some ties
+    label = r.randint(0, 2, (n, 1)).astype("float32")
+    query = r.randint(0, 3, (n, 1)).astype("int64")
+    weight = r.rand(n, 1).astype("float32")
+    pos, neg, neu = _pnpair_oracle(score, label, query, column=0,
+                                   weight=weight)
+    acc = np.array([1.5], "float32")
+    out = run_op("positive_negative_pair",
+                 {"Score": score, "Label": label, "QueryID": query,
+                  "Weight": weight,
+                  "AccumulatePositivePair": acc,
+                  "AccumulateNegativePair": acc,
+                  "AccumulateNeutralPair": acc},
+                 ["PositivePair", "NegativePair", "NeutralPair"],
+                 attrs={"column": 0})
+    np.testing.assert_allclose(np.asarray(out["PositivePair"]), [pos + 1.5], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["NegativePair"]), [neg + 1.5], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["NeutralPair"]), [neu + 1.5], rtol=1e-5)
