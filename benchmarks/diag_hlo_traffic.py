@@ -1,0 +1,103 @@
+"""Approximate per-instruction HBM traffic from an XLA HLO dump.
+
+Parses the ENTRY computation (fusion boundaries = HBM traffic: each
+top-level instruction reads its operands and writes its output), attributing
+bytes to instruction names. Diffing two dumps localizes a bytes-accessed gap
+reported by cost_analysis (run benchmarks/diag_overhead.py first to produce
+/tmp/hlo_paddle.txt and /tmp/hlo_raw.txt).
+"""
+import collections
+import re
+import sys
+
+import numpy as np
+
+DTYPE_BYTES = {"f32": 4, "bf16": 2, "s32": 4, "u32": 4, "pred": 1,
+               "s64": 8, "u64": 8, "f16": 2, "s8": 1, "u8": 1, "f64": 8,
+               "c64": 8, "c128": 16, "s16": 2, "u16": 2}
+
+SHAPE_RE = re.compile(r"\b(%s)\[([\d,]*)\]" % "|".join(DTYPE_BYTES))
+DEF_RE = re.compile(r"^\s*(?:ROOT )?([%\w.\-]+) = ")
+OPERAND_RE = re.compile(r"%([\w.\-]+)")
+
+
+def shape_bytes(sig):
+    total = 0
+    for m in SHAPE_RE.finditer(sig):
+        dims = m.group(2)
+        n = int(np.prod([int(d) for d in dims.split(",")])) if dims else 1
+        total += n * DTYPE_BYTES[m.group(1)]
+    return total
+
+
+def parse(path):
+    """-> (def_shapes: name->bytes, entry_lines: [line])."""
+    def_shapes = {}
+    entry_lines = []
+    in_entry = False
+    with open(path) as f:
+        for line in f:
+            if line.startswith("ENTRY "):
+                in_entry = True
+                continue
+            if in_entry and line.startswith("}"):
+                in_entry = False
+            m = DEF_RE.match(line)
+            if m:
+                name = m.group(1).lstrip("%")
+                rhs = line.split("=", 1)[1]
+                # bytes of the defined value: shapes before the opcode's "("
+                head = rhs.split("(", 1)[0] if "(" in rhs else rhs
+                def_shapes[name] = shape_bytes(head)
+                if in_entry:
+                    entry_lines.append(line)
+    return def_shapes, entry_lines
+
+
+SKIP_OPS = {"parameter", "get-tuple-element", "tuple", "constant", "bitcast",
+            "after-all"}
+
+
+def traffic(path):
+    def_shapes, entry_lines = parse(path)
+    per_op = collections.Counter()
+    for line in entry_lines:
+        m = DEF_RE.match(line)
+        rhs = line.split("=", 1)[1].strip()
+        opcode = re.match(r"[\w\[\]{},.:()\s]*?(\w[\w\-]*)\(", rhs)
+        opcode = opcode.group(1) if opcode else "?"
+        if opcode in SKIP_OPS:
+            continue
+        name = m.group(1).lstrip("%")
+        out_b = def_shapes.get(name, 0)
+        # operand reads: resolve %refs in the argument list
+        args = rhs.split("(", 1)[1] if "(" in rhs else ""
+        args = args.split("calls=")[0].split("to_apply=")[0]
+        in_b = sum(def_shapes.get(r, 0) for r in OPERAND_RE.findall(args))
+        per_op[_bucket(name)] += out_b + in_b
+    return per_op
+
+
+def _bucket(name):
+    """fusion.123 -> fusion; keep distinctive names."""
+    return re.sub(r"[.\d]+$", "", name)
+
+
+def main():
+    t_p = traffic("/tmp/hlo_paddle.txt")
+    t_r = traffic("/tmp/hlo_raw.txt")
+    print("total paddle %.2f GB   raw %.2f GB" %
+          (sum(t_p.values()) / 1e9, sum(t_r.values()) / 1e9))
+    keys = sorted(set(t_p) | set(t_r),
+                  key=lambda k: -abs(t_p.get(k, 0) - t_r.get(k, 0)))
+    print("%-28s %10s %10s %10s" % ("op", "paddle GB", "raw GB", "delta GB"))
+    for k in keys[:20]:
+        d = (t_p.get(k, 0) - t_r.get(k, 0)) / 1e9
+        if abs(d) < 0.05:
+            continue
+        print("%-28s %10.2f %10.2f %+10.2f"
+              % (k, t_p.get(k, 0) / 1e9, t_r.get(k, 0) / 1e9, d))
+
+
+if __name__ == "__main__":
+    main()

@@ -38,10 +38,22 @@ class QuantizationTransformPass(Pass):
 
     def apply(self, program, startup_program=None):  # reference signature
         if startup_program is not None:
-            # explicit arg wins; a user-set attr (the only channel available
-            # through PassBuilder.apply_all, which calls apply(program) bare)
-            # must survive an argless call
+            # explicit arg wins, but only for THIS apply: a startup program
+            # pairs with one main program, so letting it persist would
+            # inject a later program's scale initializers into the wrong
+            # startup. An attr set via set_attr (the only channel through
+            # PassBuilder.apply_all, which calls apply(program) bare) is a
+            # deliberate standing pairing and survives.
+            had_prior = self.has_attr("startup_program")
+            prior = self._attrs.get("startup_program")
             self.set_attr("startup_program", startup_program)
+            try:
+                return super().apply(program)
+            finally:
+                if had_prior:
+                    self._attrs["startup_program"] = prior
+                else:
+                    self._attrs.pop("startup_program", None)
         return super().apply(program)
 
     def apply_impl(self, program):

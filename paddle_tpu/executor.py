@@ -309,10 +309,9 @@ class Executor:
         self.place = place
         self._cache: Dict[tuple, _CompiledStep] = {}
         self._step_counters: Dict[int, int] = {}
-        # (id(program), version) -> sorted persistable names; recomputed only
-        # when the program mutates (version bump). Walking every program var
-        # per run() was the single largest host cost per step.
-        self._pnames_cache: Dict[tuple, Tuple[str, ...]] = {}
+        # persistable-name tuples are cached on each Program (see run()):
+        # recomputed only on version bump, freed with the Program. Walking
+        # every program var per run() was the single largest host cost.
 
     def close(self):
         """Parity with executor.py:388 (pserver notify) — nothing to release."""
@@ -420,11 +419,15 @@ class Executor:
             feeds[name] = arr
             feed_sig.append((name, arr.shape, str(arr.dtype)))
 
-        pkey = (id(program), program._version)
-        state_names = self._pnames_cache.get(pkey)
-        if state_names is None:
+        # cache lives ON the Program (keyed by version) so it dies with it —
+        # an executor-held dict keyed by id(program) leaks entries per
+        # mutation and can silently serve a stale tuple after id() reuse
+        cached = getattr(program, "_pnames_cache_entry", None)
+        if cached is not None and cached[0] == program._version:
+            state_names = cached[1]
+        else:
             state_names = self._persistable_names(program, scope)
-            self._pnames_cache[pkey] = state_names
+            program._pnames_cache_entry = (program._version, state_names)
         # state vars that actually exist (startup creates them on first run);
         # iteration follows the pre-sorted state_names so no per-step re-sort
         state = {}

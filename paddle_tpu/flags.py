@@ -26,6 +26,10 @@ _DEFAULTS: Dict[str, Any] = {
                                      # S=8192; composed wins below (its single
                                      # fused HLO beats the kernel's fixed
                                      # grid overhead at short S)
+    "attention_softmax_f32": False,  # composed-attention softmax in f32:
+                                     # +5 GB/step on Transformer-base (XLA
+                                     # materializes the f32 probs for bwd);
+                                     # default bf16 matches raw-JAX practice
     "ring_flash_min_block": 2048,    # ring attention: local shard length at
                                      # which the per-block compute switches
                                      # from composed to the Pallas flash

@@ -135,13 +135,27 @@ def sdpa(q, k, v, bias=None, segment_ids_q=None, segment_ids_kv=None,
         sq, sk = scores.shape[-2], scores.shape[-1]
         cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         scores = jnp.where(cm, scores, jnp.full_like(scores, -1e9))
-    # dtype-preserving softmax: in bf16 the saved probs halve the S² HBM
-    # traffic (the flash kernel keeps f32 accumulation internally; over
-    # hundreds of keys bf16 probs match f32 to ~1e-2, same as raw JAX)
-    probs = jax.nn.softmax(scores, axis=-1)
+    # dtype-preserving softmax by default: every f32-accumulation variant
+    # measured COSTS HBM on the Transformer bench (diag_overhead.py, r4) —
+    # forcing bf16-probs residuals via custom_vjp +1.9 GB/step, f32-cast
+    # softmax +5 GB (XLA saves the f32 output for the backward) — while
+    # XLA's own residual choice beats both. FLAGS_attention_softmax_f32
+    # buys the f32 softmax at that cost for accuracy-sensitive runs;
+    # per-op agreement vs f32 is ~1e-2 either way (ADVICE r3).
+    from ..flags import get_flag
+
+    if get_flag("attention_softmax_f32"):
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1) \
+            .astype(scores.dtype)
+    else:
+        probs = jax.nn.softmax(scores, axis=-1)
     if dropout_rate > 0.0 and dropout_rng is not None:
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
-        probs = probs * keep.astype(probs.dtype) / (1.0 - dropout_rate)
+        # where-on-pred keeps the saved residual at 1 byte/element (see
+        # tensor_ops.dropout_op)
+        probs = jnp.where(
+            keep, probs * jnp.asarray(1.0 / (1.0 - dropout_rate), probs.dtype),
+            jnp.zeros((), probs.dtype))
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 

@@ -32,15 +32,29 @@ def _flatten_to_2d(x, num_col_dims: int):
 
 @register_op("mul")
 def mul_op(ctx: OpContext):
-    """Flattened matmul (reference: operators/mul_op.cc). FC's engine."""
+    """Flattened matmul (reference: operators/mul_op.cc). FC's engine.
+
+    TPU-first: ONE dot_general contracting x's trailing dims against y's
+    leading dims — no 2D reshape round-trip. The explicit flatten the
+    reference's kernel does (and this op did pre-r4) inserted [B*S, D]
+    bitcasts around every fc that broke XLA layout propagation through the
+    attention combine-heads transpose, materializing 36 physical-layout
+    copies (+4.4 GB/step) on the Transformer-base bench (diag_hlo_traffic).
+    """
     x, y = ctx.input("X"), ctx.input("Y")
     xd = ctx.attr("x_num_col_dims", 1)
     yd = ctx.attr("y_num_col_dims", 1)
-    x2 = _flatten_to_2d(x, xd)
-    y2 = y.reshape(_dim_prod(y.shape[:yd]), -1)
-    out2 = jnp.matmul(x2, y2)
-    out_shape = x.shape[:xd] + y.shape[yd:]
-    ctx.set_output("Out", out2.reshape(out_shape))
+    if tuple(x.shape[xd:]) != tuple(y.shape[:yd]):
+        # contraction matches in product, not per-dim (flatten semantics):
+        # reshape the WEIGHT side (small, layout-free) so the activation
+        # never round-trips through a 2D flatten
+        y = y.reshape(tuple(x.shape[xd:]) + tuple(y.shape[yd:]))
+        yd = x.ndim - xd
+    out = jax.lax.dot_general(
+        x, y,
+        dimension_numbers=((tuple(range(xd, x.ndim)), tuple(range(yd))),
+                           ((), ())))
+    ctx.set_output("Out", out)
 
 
 @register_op("matmul")
@@ -69,10 +83,23 @@ def _elementwise(ctx: OpContext, fn):
     if amp is not None and hasattr(x, "dtype") and hasattr(y, "dtype"):
         from ..core.dtypes import to_jnp_dtype
 
+        def castable(slot):
+            # Only ACTIVATIONS autocast. Persistable vars (parameters, AMP
+            # master weights, user state) keep their deliberate f32 — the
+            # rule targets accidental promotions (an f32 constant entering
+            # the bf16 stream), not user-pinned precision.
+            names = ctx.op.inputs.get(slot)
+            if not names:
+                return True
+            block = getattr(ctx.op, "block", None)
+            if block is None or not block.has_var(names[0]):
+                return True  # op-test harness vars: no Variable metadata
+            return not block.var(names[0]).persistable
+
         adt = jnp.dtype(to_jnp_dtype(amp))
-        if x.dtype == adt and y.dtype == jnp.float32:
+        if x.dtype == adt and y.dtype == jnp.float32 and castable("Y"):
             y = y.astype(adt)
-        elif y.dtype == adt and x.dtype == jnp.float32:
+        elif y.dtype == adt and x.dtype == jnp.float32 and castable("X"):
             x = x.astype(adt)
     axis = ctx.attr("axis", -1)
     if x.shape != y.shape and axis != -1 and y.ndim < x.ndim:

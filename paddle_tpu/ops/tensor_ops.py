@@ -408,13 +408,17 @@ def dropout_op(ctx: OpContext):
         ctx.set_output("Out", x)
         ctx.set_output("Mask", jnp.ones_like(x))
         return
-    mask = jax.random.bernoulli(ctx.rng(), 1.0 - p, x.shape).astype(x.dtype)
+    # keep the mask as PRED through the where: the backward residual is then
+    # the 1-byte bool, not an x.dtype mask — one byte/element less HBM
+    # traffic per dropout site (matters at [B,H,S,S] attention sites)
+    keep = jax.random.bernoulli(ctx.rng(), 1.0 - p, x.shape)
     if impl == "upscale_in_train":
-        out = x * mask / jnp.asarray(1.0 - p, x.dtype)
+        out = jnp.where(keep, x * jnp.asarray(1.0 / (1.0 - p), x.dtype),
+                        jnp.zeros((), x.dtype))
     else:
-        out = x * mask
+        out = jnp.where(keep, x, jnp.zeros((), x.dtype))
     ctx.set_output("Out", out)
-    ctx.set_output("Mask", mask)
+    ctx.set_output("Mask", keep.astype(x.dtype))
 
 
 @register_op("shuffle_channel")

@@ -56,6 +56,15 @@ def gpipe(stage_fn: Callable, mesh: Mesh, axis: str = "pipe"):
 
     Returns outputs [M, mb, ...], sharded over the pipe axis on the M dim.
     Differentiable.
+
+    Compile-time note: the tick loop is unrolled at trace time (static
+    ppermute pairs are what let the feed/collect hops be single-pair ICI
+    sends), so the traced graph holds M+S-1 copies of ``stage_fn`` forward
+    — and its AD transpose again in the backward. Compile time and HLO
+    size scale linearly with microbatch count; past a few dozen
+    microbatches prefer fewer, larger microbatches (the bubble fraction
+    (S-1)/(M+S-1) has diminishing returns in M anyway). A warning fires at
+    trace time beyond ~64 ticks.
     """
     s = mesh.shape[axis]
 
@@ -67,6 +76,14 @@ def gpipe(stage_fn: Callable, mesh: Mesh, axis: str = "pipe"):
         mloc = x_loc.shape[0]
         m = mloc * s
         ticks = m + s - 1
+        if ticks > 64:
+            import warnings
+
+            warnings.warn(
+                "gpipe: %d microbatches over %d stages unrolls %d copies of "
+                "stage_fn into the traced graph (plus transposes in the "
+                "backward) — expect slow compiles; prefer fewer, larger "
+                "microbatches" % (m, s, ticks), stacklevel=3)
         out = jnp.zeros_like(x_loc)
         recv = jnp.zeros_like(x_loc[0])
         fwd_perm = [(i, i + 1) for i in range(s - 1)]
