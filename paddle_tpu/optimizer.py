@@ -340,8 +340,45 @@ class AdamOptimizer(Optimizer):
         for p in parameters:
             self._add_accumulator("moment1", p)
             self._add_accumulator("moment2", p)
-            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1])
-            self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2, shape=[1])
+        if not parameters:
+            return
+        if getattr(self, "_imperative", False):
+            for p in parameters:
+                self._add_accumulator("beta1_pow_acc", p,
+                                      fill_value=self._beta1, shape=[1])
+                self._add_accumulator("beta2_pow_acc", p,
+                                      fill_value=self._beta2, shape=[1])
+            return
+        # ONE shared beta-pow pair for the whole parameter set (optax keeps a
+        # single step count the same way). The reference's per-param [1]
+        # scalars are always numerically identical, but 2 extra scalar state
+        # vars PER PARAM give every Adam fusion a distinct operand set, which
+        # blocks XLA's horizontal fusion of the ~1-per-param update kernels —
+        # measured 10.6 ms/step of pure launch latency on BERT-base (133
+        # params, r4). The single bump happens once in _finish_update, after
+        # every param op has read the step-t value.
+        p0 = parameters[0]
+        b1 = self._add_accumulator("beta1_pow_acc", p0,
+                                   fill_value=self._beta1, shape=[1])
+        b2 = self._add_accumulator("beta2_pow_acc", p0,
+                                   fill_value=self._beta2, shape=[1])
+        for p in parameters[1:]:
+            self._accumulators["beta1_pow_acc"][p.name] = b1
+            self._accumulators["beta2_pow_acc"][p.name] = b2
+
+    def _finish_update(self, block, parameters_and_grads):
+        if getattr(self, "_imperative", False):
+            return
+        pows = self._accumulators.get("beta1_pow_acc")
+        if not pows:
+            return
+        for acc_name, beta in (("beta1_pow_acc", self._beta1),
+                               ("beta2_pow_acc", self._beta2)):
+            accs = {v.name: v for v in self._accumulators[acc_name].values()}
+            for var in accs.values():  # one shared var normally
+                block.append_op("scale", inputs={"X": var},
+                                outputs={"Out": var},
+                                attrs={"scale": beta, "bias": 0.0})
 
     def _extra_attrs(self):
         """Attrs beyond plain Adam's (AdamW/Lamb decay). Must be supplied
@@ -356,12 +393,17 @@ class AdamOptimizer(Optimizer):
         b2p = self._get_accumulator("beta2_pow_acc", p)
         attrs = {"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon}
         attrs.update(self._extra_attrs())
+        if getattr(self, "_imperative", False):
+            outputs = {"ParamOut": p, "Moment1Out": m1, "Moment2Out": m2,
+                       "Beta1PowOut": b1p, "Beta2PowOut": b2p}
+        else:
+            # pows are SHARED read-only here; _finish_update bumps them once
+            outputs = {"ParamOut": p, "Moment1Out": m1, "Moment2Out": m2}
         return block.append_op(
             self.type,
             inputs={"Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
                     "Beta1Pow": b1p, "Beta2Pow": b2p, "LearningRate": self._lr_input(p)},
-            outputs={"ParamOut": p, "Moment1Out": m1, "Moment2Out": m2,
-                     "Beta1PowOut": b1p, "Beta2PowOut": b2p},
+            outputs=outputs,
             attrs=attrs,
         )
 
