@@ -23,6 +23,7 @@ def deepfm(
     num_fields=26,
     layer_sizes=(400, 400, 400),
     is_test=False,
+    is_sparse=True,
 ):
     """sparse_ids: [batch, num_fields] int64 (global hashed ids);
     dense_feat: [batch, dense_dim] float32; label: [batch, 1] int64.
@@ -33,12 +34,16 @@ def deepfm(
         initializer=init_mod.TruncatedNormal(0.0, 1.0 / (embedding_size ** 0.5)),
     )
     # [b, f, e] factor embeddings + [b, f, 1] first-order weights
+    # is_sparse=True: SelectedRows-equivalent rows-only gradients + lazy
+    # optimizer updates (reference dist_ctr.py uses is_sparse=True too) —
+    # the step cost must stay independent of sparse_feature_dim
     emb = layers.embedding(sparse_ids, size=[sparse_feature_dim, embedding_size],
-                           param_attr=init)
+                           param_attr=init, is_sparse=is_sparse)
     w1 = layers.embedding(sparse_ids, size=[sparse_feature_dim, 1],
                           param_attr=layers.ParamAttr(
                               name="sparse_w1",
-                              initializer=init_mod.TruncatedNormal(0.0, 1e-4)))
+                              initializer=init_mod.TruncatedNormal(0.0, 1e-4)),
+                          is_sparse=is_sparse)
 
     # FM first order
     first_order = layers.reduce_sum(w1, dim=1)  # [b, 1]
