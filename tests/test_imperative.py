@@ -322,3 +322,55 @@ def test_save_load_dygraph_roundtrip(rng, tmp_path):
         m3(to_variable(np.ones((2, 16), dtype="float32")))
         with pytest.raises((ValueError, KeyError)):
             m3.set_state(load_dygraph(path))
+
+
+def test_imperative_jit_parity_and_speedup():
+    """VERDICT r3 #8: imperative.jit compiles a dygraph Layer's forward to
+    one XLA executable — numerics identical to eager, and the per-op
+    interpretation tax (>=10x on a small MLP loop) is gone."""
+    import time
+
+    import paddle_tpu.imperative as imp
+
+    with imp.guard(seed=3):
+        class MLP(imp.Layer):
+            def __init__(self):
+                super().__init__("mlp")
+                self.fc1 = imp.FC("fc1", 64, act="relu")
+                self.fc2 = imp.FC("fc2", 64, act="relu")
+                self.fc3 = imp.FC("fc3", 8)
+
+            def forward(self, x):
+                return self.fc3(self.fc2(self.fc1(x)))
+
+        mlp = MLP()
+        x = imp.to_variable(np.random.RandomState(0).randn(16, 32).astype("float32"))
+        want = mlp(x).numpy()
+
+        fast = imp.jit(mlp)
+        got = fast(x)
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-5, atol=1e-6)
+
+        # param updates flow without retracing
+        p0 = mlp.parameters()[0]
+        p0.value = p0.value + 1.0
+        np.testing.assert_allclose(fast(x).numpy(), mlp(x).numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+        n = 30
+        jnp_ready = fast(x).numpy()  # warm cache
+
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = mlp(x)
+        out.numpy()
+        t_eager = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fast(x)
+        out.numpy()
+        t_jit = time.perf_counter() - t0
+        assert t_eager / t_jit >= 10, (
+            "jit speedup only %.1fx (eager %.1fms vs jit %.1fms)"
+            % (t_eager / t_jit, t_eager * 1e3, t_jit * 1e3))
