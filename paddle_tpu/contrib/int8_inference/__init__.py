@@ -1,0 +1,6 @@
+"""Post-training int8 calibration (reference:
+python/paddle/fluid/contrib/int8_inference/utility.py — Calibrator)."""
+
+from .calibrator import Calibrator  # noqa: F401
+
+__all__ = ["Calibrator"]
