@@ -195,3 +195,23 @@ class TestPostTrainingCalibration:
         exe, test_prog, prob, xs, ys = self._train_fp32(rng)
         with pytest.raises(RuntimeError, match="sample_data"):
             Calibrator(test_prog, exe).calibrate()
+
+    def test_save_int8_model(self, rng, tmp_path):
+        from paddle_tpu.contrib.int8_inference import Calibrator
+
+        exe, test_prog, prob, xs, ys = self._train_fp32(rng)
+        calib = Calibrator(test_prog, exe, algo="abs_max")
+        calib.sample_data({"x": xs[:64], "y": ys[:64]})
+        out_dir = str(tmp_path / "int8_model")
+        calib.save_int8_model(out_dir, ["x"], [prob])
+        import os
+
+        assert os.path.isdir(out_dir) and os.listdir(out_dir)
+        # the saved model loads and predicts
+        with fluid.scope_guard(fluid.core.Scope()):
+            exe2 = fluid.Executor(fluid.CPUPlace())
+            prog, feeds, fetches = fluid.io.load_inference_model(out_dir, exe2)
+            out, = exe2.run(prog, feed={feeds[0]: xs[:8]}, fetch_list=fetches,
+                            return_numpy=True)
+            assert out.shape == (8, 4)
+            assert np.all(np.isfinite(out))
