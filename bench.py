@@ -665,7 +665,16 @@ def bench_bert_infer(batch=64, seq=256, use_amp=True, skip=3, iters=15):
                 pos = fluid.layers.data("pos", shape=[seq], dtype="int64")
                 sent = fluid.layers.data("sent", shape=[seq], dtype="int64")
                 mask = fluid.layers.data("mask", shape=[seq], dtype="float32")
-                seq_out, pooled = bert.bert_base(ids, pos, sent, mask,
+                # inference steps are independent, so _timeit's end-of-loop
+                # sync wouldn't transitively force them — chain each step on
+                # the previous pooled output via an in-GRAPH zero coupling
+                # (any eager per-step op would serialize on the tunnel)
+                chain = fluid.layers.data("chain", shape=[768])
+                zero = fluid.layers.cast(
+                    fluid.layers.scale(fluid.layers.reduce_sum(chain), scale=0.0),
+                    "int64")
+                ids2 = fluid.layers.elementwise_add(ids, zero)
+                seq_out, pooled = bert.bert_base(ids2, pos, sent, mask,
                                                  dropout_rate=0.0,
                                                  is_test=True)
             # the program is already built is_test/dropout-free — no
@@ -674,27 +683,22 @@ def bench_bert_infer(batch=64, seq=256, use_amp=True, skip=3, iters=15):
                 fluid.amp.enable(main_prog, "bfloat16")
             exe = fluid.Executor(fluid.TPUPlace(0))
             exe.run(startup)
-            import jax
-            import jax.numpy as jnp
-
             rng = np.random.RandomState(0)
             feed = _device_feed({
                 "ids": rng.randint(0, 30522, (batch, seq)).astype("int64"),
                 "pos": np.tile(np.arange(seq), (batch, 1)).astype("int64"),
                 "sent": np.zeros((batch, seq), "int64"),
                 "mask": np.ones((batch, seq), "float32"),
+                "chain": np.zeros((batch, 768), "float32"),
             })
-            # inference steps are independent, so _timeit's end-of-loop sync
-            # wouldn't transitively force them — chain each step's ids on a
-            # zero token derived from the previous output instead
-            carry = {"tok": jnp.zeros((), jnp.int64)}
+            carry = {"prev": feed["chain"]}
 
             def step():
                 f = dict(feed)
-                f["ids"] = feed["ids"] + carry["tok"]
+                f["chain"] = carry["prev"]
                 out, = exe.run(main_prog, feed=f, fetch_list=[pooled],
                                return_numpy=False)
-                carry["tok"] = (out[0, 0] * 0).astype(jnp.int64)
+                carry["prev"] = out
                 return out
 
             return _timeit(step, batch, skip=skip, iters=iters)
