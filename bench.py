@@ -651,9 +651,10 @@ def bench_raw_jax_bert(batch=32, seq=128, n_mask=20, vocab=30522, n_layer=12,
 def bench_bert_infer(batch=64, seq=256, use_amp=True, skip=3, iters=15):
     """BERT-base FORWARD (inference) — the compute-bound headline
     (benchmarks/TRANSFORMER_PROFILE.md): matmul-dense, no optimizer small
-    kernels, bf16 on the MXU. Measured 48.6% MFU on v5e (r4); the training
-    configs sit at ~21% because per-parameter optimizer updates and VPU ops
-    cap them, not because the framework's compute path is slow."""
+    kernels, bf16 on the MXU. Measured 0.44-0.49 MFU on v5e across tunnel
+    epochs (r4, benchmarks/TRANSFORMER_PROFILE.md); the training configs
+    sit at ~21% because per-parameter optimizer updates and VPU ops cap
+    them, not because the framework's compute path is slow."""
     import paddle_tpu as fluid
     from paddle_tpu.models import bert
 
@@ -677,6 +678,9 @@ def bench_bert_infer(batch=64, seq=256, use_amp=True, skip=3, iters=15):
                 seq_out, pooled = bert.bert_base(ids2, pos, sent, mask,
                                                  dropout_rate=0.0,
                                                  is_test=True)
+                # fetch f32 so the chained feed needs no eager per-step
+                # dtype canon under AMP (pooled itself is bf16 there)
+                pooled_f32 = fluid.layers.cast(pooled, "float32")
             # the program is already built is_test/dropout-free — no
             # backward to prune, so run it directly
             if use_amp:
@@ -696,7 +700,7 @@ def bench_bert_infer(batch=64, seq=256, use_amp=True, skip=3, iters=15):
             def step():
                 f = dict(feed)
                 f["chain"] = carry["prev"]
-                out, = exe.run(main_prog, feed=f, fetch_list=[pooled],
+                out, = exe.run(main_prog, feed=f, fetch_list=[pooled_f32],
                                return_numpy=False)
                 carry["prev"] = out
                 return out
