@@ -1,0 +1,174 @@
+"""Why don't our post-value_and_grad Adam updates fuse into the grad dots?
+
+Bisects the BERT train config feature by feature on a small MLP: each
+--with-* flag moves the repro one step toward bench_bert's setup. After
+compiling on the TPU we count, over fusion computations whose divide comes
+from optimizer_ops.py (the Adam update), how many also contain the weight-
+grad matmul (`convolution` on this backend) — vertically fused — vs stand
+alone.
+
+Usage: python benchmarks/diag_adam_fusion.py [--amp] [--dropout] [--ln]
+         [--emb] [--gelu] [--layers N] [--d N]
+"""
+from __future__ import annotations
+
+import re
+import sys
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def adam_fusion_stats(hlo: str, tag: str):
+    comps = hlo.split("\n\n")
+    fused = alone = 0
+    for c in comps:
+        if "optimizer_ops.py" not in c or " divide(" not in c:
+            continue
+        if not c.lstrip().startswith("%fused_computation"):
+            continue
+        if " convolution(" in c:
+            fused += 1
+        else:
+            alone += 1
+    print("%s: adam fusions WITH grad-matmul=%d  standalone=%d"
+          % (tag, fused, alone))
+    return fused, alone
+
+
+def adam_fusion_params(hlo: str):
+    """For every standalone adam fusion, print the output tuple shape sig."""
+    comps = hlo.split("\n\n")
+    for c in comps:
+        if "optimizer_ops.py" not in c or " divide(" not in c:
+            continue
+        if not c.lstrip().startswith("%fused_computation"):
+            continue
+        if " convolution(" in c:
+            continue
+        head = c.lstrip().split("\n", 1)[0]
+        sig = head.split("->", 1)[1] if "->" in head else head
+        print("  standalone:", sig.strip()[:100])
+
+
+def run_bert(args):
+    import paddle_tpu as fluid
+    from paddle_tpu.models import bert
+
+    n_layer = 2
+    batch, seq, n_mask = 32, 128, 20
+    cfg = dict(bert.BERT_BASE_CONFIG, n_layer=n_layer)
+    with fluid.unique_name.guard():
+        with fluid.scope_guard(fluid.Scope()):
+            main_prog, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main_prog, startup):
+                ids = fluid.layers.data("ids", shape=[seq], dtype="int64")
+                pos = fluid.layers.data("pos", shape=[seq], dtype="int64")
+                sent = fluid.layers.data("sent", shape=[seq], dtype="int64")
+                mask = fluid.layers.data("mask", shape=[seq], dtype="float32")
+                mpos = fluid.layers.data("mpos", shape=[n_mask], dtype="int64")
+                mlbl = fluid.layers.data("mlbl", shape=[1], dtype="int64")
+                nsp = fluid.layers.data("nsp", shape=[1], dtype="int64")
+                loss, _, _ = bert.bert_pretrain(ids, pos, sent, mask, mpos,
+                                                mlbl, nsp, **cfg)
+                opt = fluid.optimizer.Adam(learning_rate=1e-4)
+                if "--amp" in args:
+                    opt = fluid.amp.decorate(opt)
+                opt.minimize(loss)
+            exe = fluid.Executor(fluid.TPUPlace(0))
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            mpos_np = (np.arange(batch)[:, None] * seq
+                       + rng.randint(0, seq, (batch, n_mask))).astype("int64")
+            feed = {
+                "ids": rng.randint(0, 30522, (batch, seq)).astype("int64"),
+                "pos": np.tile(np.arange(seq), (batch, 1)).astype("int64"),
+                "sent": np.zeros((batch, seq), "int64"),
+                "mask": np.ones((batch, seq), "float32"),
+                "mpos": mpos_np,
+                "mlbl": rng.randint(0, 30522, (batch * n_mask, 1)).astype("int64"),
+                "nsp": rng.randint(0, 2, (batch, 1)).astype("int64"),
+            }
+            exe.run(main_prog, feed=feed, fetch_list=[loss],
+                    return_numpy=False)
+            compiled = next(c for c in exe._cache.values() if c.fetch_names)
+            scope = fluid.global_scope()
+            state = {n: scope.vars[n] for n in compiled.state_names
+                     if n in scope.vars}
+            comp = compiled.fn.lower(state, feed, np.uint32(0)).compile()
+            hlo_p = comp.as_text()
+            with open("/tmp/hlo_adam_bert.txt", "w") as f:
+                f.write(hlo_p)
+    adam_fusion_stats(hlo_p, "bert2[%s]" % " ".join(sorted(args)))
+    adam_fusion_params(hlo_p)
+
+
+def main():
+    args = set(sys.argv[1:])
+
+    def intarg(name, default):
+        for a in sys.argv[1:]:
+            if a.startswith(name + "="):
+                return int(a.split("=")[1])
+        return default
+
+    if "--bert" in args:
+        run_bert(args)
+        return
+
+    n_layer = intarg("--layers", 4)
+    d = intarg("--d", 512)
+    batch = 64
+
+    import paddle_tpu as fluid
+
+    with fluid.unique_name.guard():
+        with fluid.scope_guard(fluid.Scope()):
+            main_prog, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main_prog, startup):
+                if "--emb" in args:
+                    tok = fluid.layers.data("tok", shape=[1], dtype="int64")
+                    x = fluid.layers.embedding(tok, size=[1000, d])
+                    x = fluid.layers.reshape(x, [-1, d])
+                else:
+                    x = fluid.layers.data("x", shape=[d], dtype="float32")
+                y = fluid.layers.data("y", shape=[1], dtype="int64")
+                h = x
+                act = "gelu" if "--gelu" in args else "relu"
+                for _ in range(n_layer):
+                    h = fluid.layers.fc(h, size=d, act=act)
+                    if "--ln" in args:
+                        h = fluid.layers.layer_norm(h)
+                    if "--dropout" in args:
+                        h = fluid.layers.dropout(h, dropout_prob=0.1)
+                logits = fluid.layers.fc(h, size=10)
+                loss = fluid.layers.mean(
+                    fluid.layers.softmax_with_cross_entropy(logits, y))
+                opt = fluid.optimizer.Adam(learning_rate=1e-4)
+                if "--amp" in args:
+                    opt = fluid.amp.decorate(opt)
+                opt.minimize(loss)
+            exe = fluid.Executor(fluid.TPUPlace(0))
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            feed = {"y": rng.randint(0, 10, (batch, 1)).astype("int64")}
+            if "--emb" in args:
+                feed["tok"] = rng.randint(0, 1000, (batch, 1)).astype("int64")
+            else:
+                feed["x"] = rng.randn(batch, d).astype("float32")
+            exe.run(main_prog, feed=feed, fetch_list=[loss],
+                    return_numpy=False)
+            compiled = next(c for c in exe._cache.values() if c.fetch_names)
+            scope = fluid.global_scope()
+            state = {n: scope.vars[n] for n in compiled.state_names
+                     if n in scope.vars}
+            comp = compiled.fn.lower(state, feed, np.uint32(0)).compile()
+            hlo_p = comp.as_text()
+            with open("/tmp/hlo_adam_paddle.txt", "w") as f:
+                f.write(hlo_p)
+    adam_fusion_stats(hlo_p, "paddle[%s]" % " ".join(sorted(args)))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""Count ENTRY-computation kernels (launches) in paddle vs raw BERT HLO.
+
+The per-kernel launch latency on this chip is ~140 us (TRANSFORMER_PROFILE
+.md §2), so entry instruction count is the first-order model of the
+optimizer-tax gap. Prints per-opcode entry counts and dumps both HLOs.
+
+Usage: python benchmarks/diag_bert_kernels.py
+"""
+import collections
+import os
+import re
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def entry_counts(hlo, tag):
+    entry = hlo[hlo.index("\nENTRY "):]
+    entry = entry[:entry.index("\n}")]
+    per = collections.Counter()
+    for line in entry.split("\n"):
+        m = re.match(r"\s+(?:ROOT )?%[\w.\-]+ = \S+ ([a-z][a-z\-]*)\(", line)
+        if m:
+            per[m.group(1)] += 1
+    sync = {k: v for k, v in per.items()
+            if k not in ("parameter", "get-tuple-element", "tuple", "constant",
+                         "bitcast", "after-all", "copy-start", "copy-done",
+                         "slice-start", "slice-done")}
+    print("%s: sync entry instrs=%d  %s" % (
+        tag, sum(sync.values()),
+        sorted(sync.items(), key=lambda kv: -kv[1])[:12]))
+    return per
+
+
+def main():
+    import bench
+    import paddle_tpu as fluid
+    from paddle_tpu.models import bert
+
+    batch, seq, n_mask = 32, 128, 20
+    with fluid.unique_name.guard(), fluid.scope_guard(fluid.Scope()):
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            ids = fluid.layers.data("ids", shape=[seq], dtype="int64")
+            pos = fluid.layers.data("pos", shape=[seq], dtype="int64")
+            sent = fluid.layers.data("sent", shape=[seq], dtype="int64")
+            mask = fluid.layers.data("mask", shape=[seq], dtype="float32")
+            mpos = fluid.layers.data("mpos", shape=[n_mask], dtype="int64")
+            mlbl = fluid.layers.data("mlbl", shape=[1], dtype="int64")
+            nsp = fluid.layers.data("nsp", shape=[1], dtype="int64")
+            loss, _, _ = bert.bert_pretrain(ids, pos, sent, mask, mpos, mlbl,
+                                            nsp, **bert.BERT_BASE_CONFIG)
+            opt = fluid.amp.decorate(fluid.optimizer.Adam(learning_rate=1e-4))
+            opt.minimize(loss)
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        mpos_np = (np.arange(batch)[:, None] * seq
+                   + rng.randint(0, seq, (batch, n_mask))).astype("int64")
+        feed = {
+            "ids": rng.randint(0, 30522, (batch, seq)).astype("int64"),
+            "pos": np.tile(np.arange(seq), (batch, 1)).astype("int64"),
+            "sent": np.zeros((batch, seq), "int64"),
+            "mask": np.ones((batch, seq), "float32"),
+            "mpos": mpos_np,
+            "mlbl": rng.randint(0, 30522, (batch * n_mask, 1)).astype("int64"),
+            "nsp": rng.randint(0, 2, (batch, 1)).astype("int64"),
+        }
+        exe.run(main_prog, feed=feed, fetch_list=[loss], return_numpy=False)
+        compiled = next(c for c in exe._cache.values() if c.fetch_names)
+        scope = fluid.global_scope()
+        state = {n: scope.vars[n] for n in compiled.state_names
+                 if n in scope.vars}
+        comp = compiled.fn.lower(state, feed, np.uint32(0)).compile()
+        hlo_p = comp.as_text()
+        with open("/tmp/hlo_bert_paddle.txt", "w") as f:
+            f.write(hlo_p)
+
+    diag = {}
+    bench.bench_raw_jax_bert.__wrapped__ if hasattr(
+        bench.bench_raw_jax_bert, "__wrapped__") else None
+    # lower-only: reuse the _diag hook
+    orig_timeit = bench._timeit
+    bench._timeit = lambda step, b, **kw: (0.0, 0.0)
+    try:
+        bench.bench_raw_jax_bert(batch, seq, n_mask, _diag=diag)
+    finally:
+        bench._timeit = orig_timeit
+    rcomp = diag["lowered"].compile()
+    hlo_r = rcomp.as_text()
+    with open("/tmp/hlo_bert_raw.txt", "w") as f:
+        f.write(hlo_r)
+
+    entry_counts(hlo_p, "paddle")
+    entry_counts(hlo_r, "raw   ")
+
+
+if __name__ == "__main__":
+    main()

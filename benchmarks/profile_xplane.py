@@ -43,8 +43,19 @@ def parse_xplane(outdir):
             if "TPU" not in plane.name or "XLA" in plane.name:
                 continue
             ev_meta = {m.id: m.name for m in plane.event_metadata.values()}
-            op_lines = [l for l in plane.lines if "XLA Ops" in l.name]
-            for line in op_lines or plane.lines:
+            # exact match: "Async XLA Ops" (overlapped DMA spans) also
+            # contains the substring "XLA Ops" and must NOT be summed as
+            # busy time — that double-count inflated the r4 bucket numbers
+            op_lines = [l for l in plane.lines if l.name == "XLA Ops"]
+            if not op_lines:
+                # fallback for other profiler line layouts: never re-admit
+                # the async spans the exact-match filter exists to exclude
+                import warnings
+
+                warnings.warn("no 'XLA Ops' line in %s; summing non-async "
+                              "lines" % plane.name)
+                op_lines = [l for l in plane.lines if "Async" not in l.name]
+            for line in op_lines:
                 for ev in line.events:
                     nm = ev_meta.get(ev.metadata_id, "?")
                     per_op[_bucket(nm)] += ev.duration_ps / 1e9  # ms

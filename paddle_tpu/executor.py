@@ -47,14 +47,34 @@ class TraceContext:
         self.base_rng = base_rng
         self.mesh = mesh
         self.current_op_idx = 0
+        self._key_table = None
+        self._n_ops = 0
 
     def op_rng(self, ctx: OpContext):
         seed = ctx.attr("seed", 0) or self.program.random_seed
         if seed:
-            key = jax.random.PRNGKey(seed)
-        else:
-            key = self.base_rng
-        return jax.random.fold_in(key, self.current_op_idx)
+            # explicit per-op seed: a constant key XLA constant-folds
+            return jax.random.fold_in(jax.random.PRNGKey(seed),
+                                      self.current_op_idx)
+        # Derive the main-block per-op keys with one batched split instead of
+        # a scalar fold_in per RNG-consuming op: each scalar fold_in is ~113
+        # unfusable scalar u32 entry instructions (a full threefry chain),
+        # and a BERT step with ~50 dropout sites carried ~5,700 of them —
+        # the batched table is one vectorized threefry plus slices that fuse
+        # into the consumers (benchmarks/diag_bert_kernels.py).
+        # Sub-block ops (while/cond bodies) run at offset 10_000*block_idx
+        # (ops/control_flow_ops.py) — far past the table, where JAX's static
+        # indexing would silently CLAMP to the last row and hand every such
+        # op the same key — so anything past the table keeps the scalar
+        # fold_in (distinct key per index; those ops trace once inside the
+        # loop body, so the scalar chains stay rare).
+        idx = self.current_op_idx
+        if self._key_table is None:
+            self._n_ops = len(self.program.global_block.ops) + 8
+            self._key_table = jax.random.split(self.base_rng, self._n_ops)
+        if idx < self._n_ops:
+            return self._key_table[idx]
+        return jax.random.fold_in(self.base_rng, idx)
 
 
 def _canon(value, dtype_name: str):

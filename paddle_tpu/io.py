@@ -107,19 +107,28 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None, f
         store = {n: data[n] for n in data.files}
     else:
         store = None
+    def _lookup(name):
+        if store is not None:
+            return store.get(name)
+        path = os.path.join(dirname, name.replace("/", "__") + ".npy")
+        return np.load(path) if os.path.exists(path) else None
+
     missing = []
     for name in wanted:
-        if store is not None:
-            if name not in store:
-                missing.append(name)
-                continue
-            arr = store[name]
-        else:
-            path = os.path.join(dirname, name.replace("/", "__") + ".npy")
-            if not os.path.exists(path):
-                missing.append(name)
-                continue
-            arr = np.load(path)
+        arr = _lookup(name)
+        if arr is None and "_qkv" in name:
+            # r5 migration: attention stores ONE merged qkv projection (the
+            # split form's concat backward blocked optimizer fusion, see
+            # layers/attention.py); checkpoints from earlier builds hold
+            # three separate q/k/v weights (and adam moments) — concat them
+            # on load. Shapes: [d_in, d'] x3 -> [d_in, 3d'].
+            parts = [_lookup(name.replace("_qkv", s, 1))
+                     for s in ("_q", "_k", "_v")]
+            if all(p is not None for p in parts):
+                arr = np.concatenate(parts, axis=1)
+        if arr is None:
+            missing.append(name)
+            continue
         scope.set_var(name, arr)
     if missing:
         raise RuntimeError("load_vars: missing from checkpoint: %s" % missing)

@@ -70,18 +70,21 @@ def multi_head_attention(
         # fused QKV: one [D, 3·D'] matmul instead of three — the input
         # activation is read once, not three times (measured ~2.6GB/step of
         # HBM on the Transformer-base bench), and the bigger matmul tiles
-        # the MXU better. Parameters stay three separate fc-named weights
-        # (concatenated in-graph, a few MB) so checkpoints are unchanged.
+        # the MXU better. The projection is ONE merged parameter, not three
+        # concatenated ones: a concat's backward slices the [D, 3·D'] grad
+        # matmul before the optimizer, and that slice blocks XLA from
+        # vertically fusing each Adam update into the fusion producing the
+        # gradient (measured +6 standalone update kernels per encoder layer
+        # on BERT-base — benchmarks/diag_adam_fusion.py). Checkpoints from
+        # builds that stored q/k/v separately can be migrated by
+        # concatenating the three weights along axis 1.
         d_in = int(queries.shape[-1])
         sizes = (d_key * n_head, d_key * n_head, d_value * n_head)
-        ws = []
-        for suffix, sz in zip(("_q", "_k", "_v"), sizes):
-            h = LayerHelper("fc", param_attr=param_initializer,
-                            name=(name and name + suffix))
-            ws.append(h.create_parameter(param_initializer, shape=[d_in, sz],
-                                         dtype=queries.dtype))
-        helper = LayerHelper("fc", name=name and name + "_qkv")
-        wqkv = tensor.concat(ws, axis=1)
+        helper = LayerHelper("fc", param_attr=param_initializer,
+                             name=name and name + "_qkv")
+        wqkv = helper.create_parameter(param_initializer,
+                                       shape=[d_in, sum(sizes)],
+                                       dtype=queries.dtype)
         qkv = helper.create_variable_for_type_inference(queries.dtype)
         helper.append_op("mul", inputs={"X": queries, "Y": wqkv},
                          outputs={"Out": qkv},

@@ -3,10 +3,10 @@
 The reference has NO fused attention — transformer models compose it from
 primitive ops in Python (reference: tests/unittests/dist_transformer.py,
 SURVEY.md §5.7). On TPU the fused kernel is the single most important op for
-transformer throughput: this op lowers to the Pallas TPU flash-attention
-kernel (jax.experimental.pallas.ops.tpu.flash_attention) when running on TPU
-hardware, with an XLA-composed fallback elsewhere (CPU tests, odd shapes,
-attention dropout). Segment-ids support is the XLA-native replacement for
+transformer throughput: this op lowers to the project-vendored Pallas TPU
+flash-attention kernel (ops/pallas_kernels/flash_attention.py) when running
+on TPU hardware, with an XLA-composed fallback elsewhere (CPU tests, odd
+shapes, attention dropout). Segment-ids support is the XLA-native replacement for
 Fluid's LoD variable-length batching.
 """
 
@@ -23,7 +23,9 @@ from ..core.registry import OpContext, register_op
 @functools.lru_cache(maxsize=1)
 def _flash_fn():
     try:
-        from jax.experimental.pallas.ops.tpu.flash_attention import (
+        # project-owned vendored kernels (ops/pallas_kernels/flash_attention
+        # .py) — a JAX upgrade can no longer change the kernels under us
+        from .pallas_kernels.flash_attention import (
             SegmentIds,
             flash_attention,
         )
@@ -60,7 +62,7 @@ def _tuned_block_sizes(sq: int, sk: int):
     the VMEM working set starts thrashing. Blocks must divide the sequence
     lengths, so shorter/ragged sequences fall back to the largest divisor.
     """
-    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+    from .pallas_kernels.flash_attention import BlockSizes
 
     bq, bk = _pick_block(sq), _pick_block(sk)
     return BlockSizes(

@@ -92,3 +92,51 @@ def test_save_load_inference_model(tmp_path, rng):
     types = [op.type for op in prog.global_block.ops]
     assert "softmax_with_cross_entropy" not in types
     assert "sgd" not in types
+
+
+def test_load_vars_migrates_split_qkv(tmp_path, rng):
+    """Checkpoints from builds that stored q/k/v projections separately load
+    into the r5 merged-qkv layout (concat on axis 1 at load time)."""
+    import os
+
+    d_model, n_head, seq = 16, 2, 8
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[seq, d_model], dtype="float32")
+        out = fluid.layers.attention.multi_head_attention(
+            x, None, None, None, d_model // n_head, d_model // n_head,
+            d_model, n_head, is_test=True, name="mha")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = rng.randn(2, seq, d_model).astype("float32")
+    want, = exe.run(main, feed={"x": xs}, fetch_list=[out])
+
+    ckpt = str(tmp_path / "old_ckpt")
+    fluid.io.save_params(exe, ckpt, main_program=main)
+    # rewrite the merged qkv weight as the OLD three-way split layout
+    import json
+
+    with open(os.path.join(ckpt, "__index__.json")) as f:
+        index = json.load(f)
+    qkv_names = [n for n in index["vars"] if "_qkv" in n]
+    assert qkv_names, "expected a merged qkv parameter in %s" % index["vars"]
+    for n in qkv_names:
+        path = os.path.join(ckpt, n.replace("/", "__") + ".npy")
+        w = np.load(path)
+        os.remove(path)
+        for i, suffix in enumerate(("_q", "_k", "_v")):
+            part = w[:, i * d_model:(i + 1) * d_model]
+            np.save(os.path.join(
+                ckpt, n.replace("_qkv", suffix, 1).replace("/", "__") + ".npy"),
+                part)
+        index["vars"] = [m for m in index["vars"] if m != n] + [
+            n.replace("_qkv", s, 1) for s in ("_q", "_k", "_v")]
+    with open(os.path.join(ckpt, "__index__.json"), "w") as f:
+        json.dump(index, f)
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup)
+        fluid.io.load_params(exe2, ckpt, main_program=main)
+        got, = exe2.run(main, feed={"x": xs}, fetch_list=[out])
+    np.testing.assert_allclose(want, got, rtol=1e-5, atol=1e-6)
