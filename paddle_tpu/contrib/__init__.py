@@ -2,6 +2,7 @@
 (QAT transpiler + fake-quant ops), with the reference's other contrib areas
 (slim, int8_inference, decoder) layered on the same primitives."""
 
-from . import decoder, int8_inference, quantize, slim  # noqa: F401
+from . import decoder, int8_inference, quantize, slim, utils  # noqa: F401
 from .int8_inference import Calibrator  # noqa: F401
 from .quantize import QuantizeTranspiler  # noqa: F401
+from .utils import memory_usage, op_freq_statistic  # noqa: F401

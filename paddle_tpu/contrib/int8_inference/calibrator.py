@@ -29,7 +29,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ...core.framework import Parameter, Program
-from ...core.scope import global_scope
+from ...core.scope import global_scope, scope_guard
 from ..quantize.quantize_transpiler import (
     _QUANTIZABLE_OP_TYPES,
     QuantizeTranspiler,
@@ -52,6 +52,10 @@ class Calibrator:
         self.program = program
         self.exe = exe
         self.scope = scope or global_scope()
+        # constructor feed_names/fetch_list become save_int8_model defaults
+        # (reference Calibrator carries them the same way)
+        self._default_feed_names = list(feed_names or ())
+        self._default_fetch_list = list(fetch_list or ())
         self.algo = algo
         self.weight_bits = weight_bits
         self.activation_bits = activation_bits
@@ -80,7 +84,7 @@ class Calibrator:
         """One calibration batch: observe every quantizable activation."""
         vals = self.exe.run(self.program, feed=feed,
                             fetch_list=list(self._act_names),
-                            return_numpy=True)
+                            scope=self.scope, return_numpy=True)
         for name, v in zip(self._act_names, vals):
             amax = float(np.max(np.abs(v))) if v.size else 0.0
             self._abs_max[name] = max(self._abs_max[name], amax)
@@ -126,7 +130,7 @@ class Calibrator:
         # run the quant-state initializers, then overwrite the activation
         # scales with the calibrated values (order matters: startup would
         # reset them to the 0.001 placeholder)
-        self.exe.run(startup)
+        self.exe.run(startup, scope=self.scope)
         for name, scale in self._scales().items():
             self.scope.set_var(_scale_name(name),
                                np.asarray([scale], np.float32))
@@ -134,20 +138,28 @@ class Calibrator:
         self._quant_prog = qprog
         return qprog
 
-    def save_int8_model(self, dirname: str, feed_names: Sequence[str],
-                        fetch_vars) -> None:
+    def save_int8_model(self, dirname: str, feed_names: Sequence[str] = None,
+                        fetch_vars=None) -> None:
         """Calibrate (if needed) and save the deployable int8 model
-        (reference: Calibrator.save_int8_model)."""
+        (reference: Calibrator.save_int8_model). ``feed_names``/``fetch_vars``
+        default to the constructor's feed_names/fetch_list."""
         from ... import io as fluid_io
         from ..quantize.quantize_transpiler import QuantizeTranspiler as _QT
 
+        feed_names = self._default_feed_names if feed_names is None else feed_names
+        fetch_vars = self._default_fetch_list if fetch_vars is None else fetch_vars
+        if not feed_names or not fetch_vars:
+            raise ValueError(
+                "save_int8_model needs feed_names and fetch_vars (pass them "
+                "here or to the Calibrator constructor)")
         prog = getattr(self, "_quant_prog", None) or self.calibrate()
         t = _QT(weight_bits=self.weight_bits,
                 activation_bits=self.activation_bits)
         t.convert_to_int8(prog, scope=self.scope)
-        fluid_io.save_inference_model(dirname, list(feed_names),
-                                      list(fetch_vars), self.exe,
-                                      main_program=prog)
+        with scope_guard(self.scope):
+            fluid_io.save_inference_model(dirname, list(feed_names),
+                                          list(fetch_vars), self.exe,
+                                          main_program=prog)
 
 
 def _kl_threshold(hist: np.ndarray, hist_range: float, bits: int = 8) -> float:

@@ -7,7 +7,7 @@ design notes in tracer.py.
 
 from . import functional  # noqa: F401
 from .base import enabled, guard, load_dygraph, save_dygraph, to_variable  # noqa: F401
-from .jit import jit  # noqa: F401
+from .jit import jit, jit_train  # noqa: F401
 from .layers import Layer, PyLayer  # noqa: F401
 from .nn import FC, BatchNorm, Conv2D, Embedding, Pool2D  # noqa: F401
 from .tracer import EagerBlock, Tracer, VarBase, current_tracer, dispatch, trace_fn  # noqa: F401
@@ -18,5 +18,5 @@ __all__ = [
     "enabled", "guard", "to_variable", "save_dygraph", "load_dygraph", "Layer", "PyLayer",
     "FC", "BatchNorm", "Conv2D", "Embedding", "Pool2D",
     "VarBase", "Tracer", "current_tracer", "dispatch", "trace_fn", "F",
-    "functional", "EagerBlock", "jit",
+    "functional", "EagerBlock", "jit", "jit_train",
 ]

@@ -14,12 +14,16 @@ updates to ``layer.parameters()`` take effect without retracing; a reshape
 of the inputs triggers exactly one recompile per new shape, like the static
 executor's program cache.
 
-Scope: forward/inference. The compiled call returns ``stop_gradient``
-VarBases — the eager tape cannot see through an XLA executable. For full
-training-step compilation use the static Program path (that IS the
-framework's training story); this helper exists so dygraph-style code stops
-paying the per-op interpretation tax where it hurts most (eval loops,
-generation, metrics).
+``jit`` compiles forward/inference (its outputs are ``stop_gradient`` —
+the eager tape cannot see through an XLA executable). ``jit_train``
+compiles a FULL train step — forward, backward, optimizer update — into
+one executable with donated parameter/accumulator buffers: inside the
+trace the backward comes from ``jax.value_and_grad`` over the traced
+forward (the tape is bypassed) and the update reuses the optimizer's own
+eager update math on traced arrays, so every optimizer subclass works
+unchanged. This is the dygraph twin of the static Executor's fused train
+step (reference: the ProgramTranslator/@declarative direction of later
+versions).
 """
 
 from __future__ import annotations
@@ -32,7 +36,7 @@ import jax.numpy as jnp
 from .layers import Layer
 from .tracer import VarBase
 
-__all__ = ["jit"]
+__all__ = ["jit", "jit_train"]
 
 
 def jit(target: Any) -> Callable:
@@ -85,3 +89,151 @@ def jit(target: Any) -> Callable:
 
     wrapper._jit_fn = compiled
     return wrapper
+
+
+def _unique_slots(optimizer):
+    """Deterministic list of the optimizer's UNIQUE eager accumulator slots
+    (shared slots — e.g. Adam's one beta-pow pair — appear once)."""
+    slots, seen = [], set()
+    for name in sorted(optimizer._accumulators):
+        per_param = optimizer._accumulators[name]
+        for pname in sorted(per_param):
+            s = per_param[pname]
+            if id(s) not in seen:
+                seen.add(id(s))
+                slots.append(s)
+    return slots
+
+
+def jit_train(loss_fn: Callable, layer: Layer, optimizer) -> Callable:
+    """Compile a dygraph train step to ONE donated-buffer XLA executable.
+
+    ``loss_fn(*inputs) -> scalar-loss VarBase`` is dygraph code over
+    ``layer`` (any registered ops). Returns ``step(*inputs) -> loss
+    VarBase``; each call runs forward+backward+update fused, updating
+    ``layer.parameters()`` and the optimizer's accumulators in place.
+
+    >>> step = imperative.jit_train(
+    ...     lambda img, lbl: F.mean(F.softmax_with_cross_entropy(mlp(img), lbl)),
+    ...     mlp, fluid.optimizer.Adam(1e-3))
+    >>> for img, lbl in batches:
+    ...     loss = step(img, lbl)
+
+    The FIRST call runs one ordinary eager step (it materializes lazily-
+    built parameters and the optimizer's accumulators, whose set must be
+    final before the trace); subsequent calls are compiled. Per-step
+    dropout keys derive from a traced step counter, so masks differ per
+    step without retracing. Do not mix ``step()`` with manual
+    ``loss._backward()`` on the same tape in the same iteration.
+    """
+    from .tracer import current_tracer
+
+    state: Dict[str, Any] = {"compiled": None, "step": 0}
+
+    def _params():
+        ps = [p for p in layer.parameters() if p.trainable]
+        return sorted(ps, key=lambda p: p.name)
+
+    def _buffers():
+        """Non-trainable carried state: frozen parameters plus persistable
+        VarBases mutated by forward (e.g. BatchNorm running stats). They
+        ride the trace as inputs and (via has_aux) outputs — without this,
+        a buffer assigned inside the traced forward would be left holding a
+        leaked tracer and its updates silently dropped."""
+        out = {id(p): p for p in layer.parameters() if not p.trainable}
+        for lyr in [layer] + layer.sublayers():
+            for v in vars(lyr).values():
+                if isinstance(v, VarBase) and v.persistable:
+                    out.setdefault(id(v), v)
+        return sorted(out.values(), key=lambda b: b.name)
+
+    def _eager_step(*inputs):
+        ins = [x if isinstance(x, VarBase) else VarBase(jnp.asarray(x), stop_gradient=True)
+               for x in inputs]
+        loss = loss_fn(*ins)
+        loss._backward()
+        optimizer._imperative_minimize(loss, parameter_list=_params())
+        for p in _params():
+            p.clear_gradient()
+        return VarBase(loss.value, stop_gradient=True)
+
+    def _build():
+        ps = _params()
+        bufs = _buffers()
+        slots = _unique_slots(optimizer)
+        tracer = current_tracer()
+
+        def run(param_vals, buf_vals, acc_vals, step_idx, input_vals):
+            old_p = [p.value for p in ps]
+            old_g = [p._grad for p in ps]
+            old_b = [b.value for b in bufs]
+            old_a = [s.value for s in slots]
+            old_key, old_ctr = tracer._key, tracer._counter
+            try:
+                # per-step RNG: fold the traced step index into the guard's
+                # seed key so dropout masks vary per call without retracing
+                tracer._key = jax.random.fold_in(old_key, step_idx)
+                tracer._counter = 0
+                for s, v in zip(slots, acc_vals):
+                    s.value = v
+
+                def pure(pvals):
+                    for p, v in zip(ps, pvals):
+                        p.value = v
+                    for b, v in zip(bufs, buf_vals):
+                        b.value = v
+                    ins = [VarBase(v, stop_gradient=True) for v in input_vals]
+                    out = loss_fn(*ins)
+                    # buffers mutated by forward (BN stats) become aux
+                    # OUTPUTS — the only legal way their in-trace values
+                    # may escape value_and_grad
+                    return (jnp.sum(out.value.astype(jnp.float32)),
+                            [b.value for b in bufs])
+
+                (loss, new_b), grads = jax.value_and_grad(
+                    pure, has_aux=True)(param_vals)
+                for p, v, g in zip(ps, param_vals, grads):
+                    p.value = v
+                    p._grad = g
+                optimizer._imperative_minimize(None, parameter_list=ps)
+                new_p = [p.value for p in ps]
+                new_a = [s.value for s in slots]
+                return loss, new_p, new_b, new_a
+            finally:
+                for p, v, g in zip(ps, old_p, old_g):
+                    p.value = v
+                    p._grad = g
+                for b, v in zip(bufs, old_b):
+                    b.value = v
+                for s, v in zip(slots, old_a):
+                    s.value = v
+                tracer._key, tracer._counter = old_key, old_ctr
+
+        return ps, bufs, slots, jax.jit(run, donate_argnums=(0, 1, 2))
+
+    def step(*inputs):
+        if state["compiled"] is None:
+            if not layer._built or not optimizer._accumulators:
+                # warmup: one true eager step finalizes params + slots
+                out = _eager_step(*inputs)
+                state["step"] += 1
+                state["compiled"] = _build()
+                return out
+            state["compiled"] = _build()
+        ps, bufs, slots, compiled = state["compiled"]
+        input_vals = [x.value if isinstance(x, VarBase) else jnp.asarray(x)
+                      for x in inputs]
+        loss, new_p, new_b, new_a = compiled(
+            [p.value for p in ps], [b.value for b in bufs],
+            [s.value for s in slots], jnp.uint32(state["step"]), input_vals)
+        state["step"] += 1
+        for p, v in zip(ps, new_p):
+            p.value = v
+        for b, v in zip(bufs, new_b):
+            b.value = v
+        for s, v in zip(slots, new_a):
+            s.value = v
+        return VarBase(loss, stop_gradient=True)
+
+    step._jit_state = state
+    return step

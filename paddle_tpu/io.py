@@ -10,8 +10,10 @@ the role ``save_inference_model`` plays in Fluid.
 
 from __future__ import annotations
 
+import glob
 import json
 import os
+import re
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -126,6 +128,30 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None, f
                      for s in ("_q", "_k", "_v")]
             if all(p is not None for p in parts):
                 arr = np.concatenate(parts, axis=1)
+        if arr is None:
+            # r4 layout change: Adam keeps ONE shared beta-pow pair (vs the
+            # earlier per-param scalars). Either direction, every pow var of
+            # the same beta is numerically identical — fill a missing one
+            # from any checkpointed sibling.
+            m = re.search(r"_(adam\w*)_(beta[12]_pow_acc)", name)
+            if m is not None:
+                pat = "_%s_%s" % (m.group(1), m.group(2))
+                if store is not None:
+                    cands = [store[k] for k in store if pat in k]
+                else:
+                    cands = [np.load(h) for h in glob.glob(os.path.join(
+                        dirname, "*%s*.npy" % pat.replace("/", "__")))]
+                if cands:
+                    # refuse ambiguity: with several Adam instances at
+                    # different step counts the siblings differ — silently
+                    # picking one would skew bias correction on resume
+                    if any(not np.array_equal(c, cands[0]) for c in cands[1:]):
+                        raise RuntimeError(
+                            "load_vars: cannot migrate %r — checkpoint holds "
+                            "multiple distinct %s values (several Adam "
+                            "instances?); rename or load explicitly"
+                            % (name, pat))
+                    arr = cands[0]
         if arr is None:
             missing.append(name)
             continue

@@ -39,7 +39,8 @@ def _residual(x, y, dropout_rate, is_test):
 
 def encoder_layer(x, attn_bias, n_head, d_key, d_value, d_model, d_inner,
                   dropout_rate=0.1, is_test=False, name=None, seg_ids=None,
-                  ffn_act="relu", inner_dropout=None, post_norm=False):
+                  ffn_act="relu", inner_dropout=None, post_norm=False,
+                  attn_dropout=None, causal=False):
     """One encoder block.
 
     ``post_norm=False`` is the pre-norm arrangement of the translation
@@ -54,10 +55,16 @@ def encoder_layer(x, attn_bias, n_head, d_key, d_value, d_model, d_inner,
     """
     if inner_dropout is None:
         inner_dropout = dropout_rate
+    if attn_dropout is None:
+        # dropout on the attention probabilities; pass 0 to keep residual
+        # dropout but let the flash kernel carry the attention (the Pallas
+        # kernel has no dropout path — modern long-context recipes drop
+        # attention-probs dropout for exactly this reason)
+        attn_dropout = dropout_rate
     att = attn_layers.multi_head_attention(
         x if post_norm else _pre_norm(x), None, None, attn_bias, d_key,
         d_value, d_model, n_head,
-        dropout_rate=dropout_rate, is_test=is_test, name=name,
+        dropout_rate=attn_dropout, causal=causal, is_test=is_test, name=name,
         segment_ids_q=seg_ids, segment_ids_kv=seg_ids)
     x = _residual(x, att, dropout_rate, is_test)
     if post_norm:
@@ -273,3 +280,25 @@ def bert_pretrain(
     nsp_loss = layers.mean(layers.softmax_with_cross_entropy(nsp_logits, nsp_labels))
     total = layers.elementwise_add(mlm_loss, nsp_loss)
     return total, mlm_loss, nsp_loss
+
+
+def causal_lm(token_ids, labels, vocab_size=32000, max_length=2048,
+              n_layer=12, n_head=16, d_model=1024, d_inner=4096,
+              dropout_rate=0.1, is_test=False):
+    """Decoder-only causal LM over the encoder blocks (pre-norm, gelu FFN,
+    causal attention). Attention-probs dropout is 0 so the Pallas flash
+    kernel carries the attention FLOPs at S >= FLAGS_flash_attention_min_seq
+    — the long-context training configuration (residual/embedding dropout
+    stay on). Returns (logits, mean token cross-entropy loss)."""
+    x = embed_inputs(token_ids, vocab_size, d_model, max_length, "lm",
+                     dropout_rate=dropout_rate, is_test=is_test)
+    d_key = d_value = d_model // n_head
+    for i in range(n_layer):
+        x = encoder_layer(x, None, n_head, d_key, d_value, d_model, d_inner,
+                          dropout_rate, is_test, name="lm_l%d" % i,
+                          ffn_act={"type": "gelu", "approximate": True},
+                          inner_dropout=0, attn_dropout=0, causal=True)
+    x = _pre_norm(x)
+    logits = layers.fc(x, size=vocab_size, num_flatten_dims=2, name="lm_head")
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, labels))
+    return logits, loss

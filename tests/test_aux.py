@@ -175,3 +175,25 @@ def test_step_profiler_table(rng):
     assert re.search(r"train\s+3\s+", table)
     assert re.search(r"eval\s+1\s+", table)
     assert "Ave(ms)" in table
+
+
+def test_contrib_memory_usage_and_op_freq(rng):
+    """contrib.memory_usage / op_freq_statistic (reference:
+    contrib/memory_usage_calc.py:46, contrib/op_frequence.py)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8])
+        h = fluid.layers.fc(x, size=16, act="relu")
+        y = fluid.layers.fc(h, size=4)
+    lo, hi, unit = fluid.contrib.memory_usage(main, batch_size=32)
+    assert unit in ("B", "KB", "MB") and 0 < lo < hi
+    lo2, hi2, _ = fluid.contrib.memory_usage(main, batch_size=64)
+    assert hi2 > hi  # scales with batch
+    with pytest.raises(ValueError):
+        fluid.contrib.memory_usage(main, batch_size=0)
+    with pytest.raises(TypeError):
+        fluid.contrib.memory_usage("nope", 8)
+
+    uni, adj = fluid.contrib.op_freq_statistic(main)
+    assert uni.get("mul", 0) >= 2 and uni.get("relu", 0) == 1
+    assert any("->" in k for k in adj)

@@ -436,3 +436,27 @@ def test_resnet_nhwc_matches_nchw(rng):
 
     # layout changes fp32 reduction order; drift compounds over train steps
     np.testing.assert_allclose(run("NCHW"), run("NHWC"), rtol=5e-3, atol=1e-3)
+
+
+def test_causal_lm_shapes_and_train_step(rng):
+    """causal_lm: logits shape, loss finite, and one train step runs."""
+    b, s, v = 2, 16, 64
+    with fluid.unique_name.guard(), fluid.scope_guard(fluid.Scope()):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", shape=[s], dtype="int64")
+            lbl = fluid.layers.data("lbl", shape=[s, 1], dtype="int64")
+            logits, loss = tfm_mod.causal_lm(ids, lbl, vocab_size=v, max_length=s,
+                                         n_layer=2, n_head=2, d_model=32,
+                                         d_inner=64)
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+        assert tuple(logits.shape) == (-1, s, v)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {"ids": rng.randint(0, v, (b, s)).astype("int64"),
+                "lbl": rng.randint(0, v, (b, s, 1)).astype("int64")}
+        l0, = exe.run(main, feed=feed, fetch_list=[loss])
+        for _ in range(12):
+            l1, = exe.run(main, feed=feed, fetch_list=[loss])
+        assert np.isfinite(l0).all() and np.isfinite(l1).all()
+        assert float(l1) < float(l0), "causal_lm loss did not decrease"
