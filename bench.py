@@ -776,10 +776,11 @@ def bench_longseq_train(batch=8, seq=2048, vocab=32000, skip=3, iters=10,
     """Long-sequence causal-LM training — the compute-bound TRAINING
     headline (VERDICT r4 #3): d_model=1024 and S=2048 push arithmetic
     intensity past v5e's ~240 FLOP/byte balance point, and the v5e-tuned
-    Pallas flash kernel carries the S^2 attention (attention-probs dropout
-    is 0 in this configuration — the kernel has no dropout path; residual/
-    embedding dropout stay on). Measured r5: 0.35 MFU (vs 0.30 bar;
-    benchmarks/TRANSFORMER_PROFILE.md section 5)."""
+    Pallas flash kernel carries the S^2 attention. Attention-probs dropout
+    is 0 here (the modern long-context recipe); the r5 in-kernel dropout
+    path supports it at ~7% step cost (22.5 vs 24.2 ex/s measured) where
+    the composed path would need a 12.9 GB probs materialization. Measured
+    r5: 0.35 MFU (vs 0.30 bar; benchmarks/TRANSFORMER_PROFILE.md §5)."""
     import paddle_tpu as fluid
     from paddle_tpu.models import transformer as tfm
 
@@ -1265,7 +1266,12 @@ def main():
             sweep = {}
             for vv in (int(1e6), int(1e7), int(5e7), int(1e8)):
                 ent = {}
+                import gc
+
                 for is_sp, lbl in ((True, "sparse"), (False, "dense")):
+                    # drop the previous run's tables BEFORE each compile —
+                    # one V=5e7 mode holds ~12 GB of p/m/v state
+                    gc.collect()
                     try:
                         e_, _ = bench_deepfm(vocab=vv, is_sparse=is_sp,
                                              skip=3, iters=10)
@@ -1273,10 +1279,6 @@ def main():
                     except Exception as ex:
                         ent[lbl + "_eps"] = None
                         ent[lbl + "_error"] = repr(ex)[:120]
-                import gc
-
-                gc.collect()  # drop the previous mode's tables before the
-                # next compile — V=5e7 holds ~12 GB of p/m/v state
                 if ent.get("sparse_eps") and ent.get("dense_eps"):
                     ent["sparse_over_dense"] = round(
                         ent["dense_eps"] / ent["sparse_eps"], 4)

@@ -56,10 +56,11 @@ def encoder_layer(x, attn_bias, n_head, d_key, d_value, d_model, d_inner,
     if inner_dropout is None:
         inner_dropout = dropout_rate
     if attn_dropout is None:
-        # dropout on the attention probabilities; pass 0 to keep residual
-        # dropout but let the flash kernel carry the attention (the Pallas
-        # kernel has no dropout path — modern long-context recipes drop
-        # attention-probs dropout for exactly this reason)
+        # dropout on the attention probabilities. Since r5 the vendored
+        # flash kernels implement dropout IN-KERNEL (ops/pallas_kernels/
+        # flash_attention.py _dropout_keep_tile), so long sequences keep the
+        # flash path either way; pass 0 to follow the modern long-context
+        # recipes that drop attention-probs dropout entirely.
         attn_dropout = dropout_rate
     att = attn_layers.multi_head_attention(
         x if post_norm else _pre_norm(x), None, None, attn_bias, d_key,
@@ -286,10 +287,13 @@ def causal_lm(token_ids, labels, vocab_size=32000, max_length=2048,
               n_layer=12, n_head=16, d_model=1024, d_inner=4096,
               dropout_rate=0.1, is_test=False):
     """Decoder-only causal LM over the encoder blocks (pre-norm, gelu FFN,
-    causal attention). Attention-probs dropout is 0 so the Pallas flash
-    kernel carries the attention FLOPs at S >= FLAGS_flash_attention_min_seq
-    — the long-context training configuration (residual/embedding dropout
-    stay on). Returns (logits, mean token cross-entropy loss)."""
+    causal attention). Attention-probs dropout is 0 (the modern
+    long-context recipe; the r5 in-kernel dropout path supports it at ~7%
+    step cost if wanted via encoder_layer's attn_dropout) and the Pallas
+    flash kernel carries the attention FLOPs at
+    S >= FLAGS_flash_attention_min_seq — the long-context training
+    configuration (residual/embedding dropout stay on). Returns
+    (logits, mean token cross-entropy loss)."""
     x = embed_inputs(token_ids, vocab_size, d_model, max_length, "lm",
                      dropout_rate=dropout_rate, is_test=is_test)
     d_key = d_value = d_model // n_head

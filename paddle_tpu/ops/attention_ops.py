@@ -99,10 +99,80 @@ def _flash_ok(q, k, causal) -> bool:
     return sq % 128 == 0 and sk % 128 == 0 and q.dtype in (jnp.float32, jnp.bfloat16)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_dropout(q, k, v, seed, causal, sm_scale, rate):
+    """Flash attention WITH in-kernel attention-probs dropout (r5).
+
+    The vendored kernels regenerate the keep-mask from a counter-based hash
+    of absolute (b, h, q, k) coordinates (_dropout_keep_tile), so forward
+    and both backward kernels agree without materializing the [B,H,S,S]
+    mask — the capability the stock kernels lack and the reason sdpa
+    previously fell back to composed O(S^2) attention whenever attention
+    dropout was on."""
+    out, _ = _flash_dropout_fwd(q, k, v, seed, causal, sm_scale, rate)
+    return out
+
+
+def _flash_dropout_fwd(q, k, v, seed, causal, sm_scale, rate):
+    from .pallas_kernels import flash_attention as fa
+
+    bq = _pick_block(q.shape[2])
+    bk = _pick_block(k.shape[2])
+    o, l, m = fa._flash_attention_impl(
+        q, k, v, None, None, True, causal, sm_scale, 1, bq, bk, bk, False,
+        dropout_rate=rate, dropout_seed=seed)
+    return o, (q, k, v, o, l, m, seed)
+
+
+def _flash_dropout_bwd(causal, sm_scale, rate, res, do):
+    import numpy as np
+
+    from .pallas_kernels import flash_attention as fa
+
+    q, k, v, o, l, m, seed = res
+    bq = _pick_block(q.shape[2])
+    bk = _pick_block(k.shape[2])
+    di = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
+    do = do.astype(q.dtype)
+    dk, dv = fa._flash_attention_bwd_dkv(
+        q, k, v, None, None, l, m, do, di,
+        block_q_major=bq, block_q=bq, block_k_major=bk, block_k=bk,
+        sm_scale=sm_scale, causal=causal,
+        mask_value=fa.DEFAULT_MASK_VALUE, debug=False,
+        dropout_rate=rate, dropout_seed=seed)
+    dq, _ = fa._flash_attention_bwd_dq(
+        q, k, v, None, None, l, m, do, di,
+        block_q_major=bq, block_k_major=bk, block_k=bk,
+        sm_scale=sm_scale, causal=causal,
+        mask_value=fa.DEFAULT_MASK_VALUE, debug=False,
+        dropout_rate=rate, dropout_seed=seed)
+    seed_ct = np.zeros(seed.shape, jax.dtypes.float0)
+    return dq, dk, dv, seed_ct
+
+
+_flash_dropout.defvjp(_flash_dropout_fwd, _flash_dropout_bwd)
+
+
 def sdpa(q, k, v, bias=None, segment_ids_q=None, segment_ids_kv=None,
          causal=False, sm_scale=1.0, dropout_rate=0.0, dropout_rng=None):
     """Scaled dot-product attention over [B, H, S, D] tensors."""
     use_flash = dropout_rate == 0.0 and _flash_ok(q, k, causal)
+    if (dropout_rate > 0.0 and dropout_rng is not None and bias is None
+            and segment_ids_q is None and segment_ids_kv is None
+            and _flash_ok(q, k, causal)):
+        # in-kernel dropout path: same gate as flash, tight scope (no
+        # bias/segments); seed derives from the op's per-step key
+        seed = jax.lax.bitcast_convert_type(
+            jax.random.bits(dropout_rng, (1,), jnp.uint32), jnp.int32)
+        try:
+            return _flash_dropout(q, k, v, seed, causal, float(sm_scale),
+                                  float(dropout_rate))
+        except Exception as e:
+            import warnings
+
+            warnings.warn(
+                "flash-with-dropout failed (%s: %s); composed fallback."
+                % (type(e).__name__, e), RuntimeWarning, stacklevel=2)
     if use_flash:
         flash, SegmentIds = _flash_fn()
         seg = None

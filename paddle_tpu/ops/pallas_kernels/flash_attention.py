@@ -40,6 +40,38 @@ DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.dtype("float32")).max)
 # .py to assert flash-vs-composed block parity without TPU hardware.
 INTERPRET = False
 
+
+# paddle_tpu: in-kernel attention-probs dropout ------------------------------
+#
+# The keep-mask is a pure function of the ABSOLUTE (batch, head, q, k)
+# element coordinates and a seed — a counter-based splitmix32-style hash in
+# plain jnp u32 ops (pltpu.prng_* has no interpret-mode lowering in this
+# JAX). Purity over coordinates means the forward kernel and BOTH backward
+# kernels regenerate bit-identical masks regardless of their tile
+# partitioning, and the composed reference can reproduce the mask outside
+# the kernel for parity tests (tests/test_flash_dropout.py).
+#
+# Dropout applies to the NORMALIZED probabilities: o = (mask*p/(1-r)) @ v
+# with the softmax stats (l, m) computed dropout-free; in the backward,
+# dv = pd^T do and ds = p*(g - di) with g = mask*dp/(1-r) and di = rowsum
+# (do*o) unchanged (the di term already contracts through the dropped
+# probabilities).
+
+def _dropout_keep_tile(dropout_rate, seed, b_idx, h_idx, q_offset, k_offset,
+                       shape):
+  rows = jax.lax.broadcasted_iota(jnp.uint32, shape, 0) + jnp.uint32(q_offset)
+  cols = jax.lax.broadcasted_iota(jnp.uint32, shape, 1) + jnp.uint32(k_offset)
+  x = rows * jnp.uint32(2654435761) ^ cols * jnp.uint32(0x85EBCA6B)
+  x = x ^ (jnp.uint32(seed)
+           + jnp.uint32(b_idx) * jnp.uint32(0x9E3779B9)
+           + jnp.uint32(h_idx) * jnp.uint32(0xC2B2AE35))
+  x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+  x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+  x = x ^ (x >> 16)
+  threshold = jnp.uint32(min(int(float(dropout_rate) * 4294967296.0),
+                             4294967295))
+  return x >= threshold
+
 NUM_LANES = 128
 NUM_SUBLANES = 8
 
@@ -359,7 +391,8 @@ def _flash_attention_kernel_single_batch(
     v_tile_ref,
     ab_tile_ref,
     q_segment_ids_tile_ref,
-    kv_segment_ids_tile_ref,  # Input arrays
+    kv_segment_ids_tile_ref,
+    seed_tile_ref,  # paddle_tpu: [1] int32 in SMEM (None without dropout)
     o_tile_ref,  # Output arrays
     l_ref,
     m_ref,
@@ -372,12 +405,17 @@ def _flash_attention_kernel_single_batch(
     block_k,
     kv_seq_len,
     mask_value,
+    dropout_rate=0.0,  # paddle_tpu
 ):
   block_k_major = k_tile_ref.shape[2]
   block_q = q_tile_ref.shape[2]
   head_dim = q_tile_ref.shape[-1]
 
   kv_seq_idx = pl.program_id(3)
+  # paddle_tpu: read program ids at kernel top level — inside pl.when/pl.loop
+  # bodies the interpret path cannot bind them
+  _b_global = pl.program_id(0) * q_tile_ref.shape[0] + batch_idx[0]
+  _h_global = pl.program_id(1)
   @pl.when(kv_seq_idx == 0)
   def start_new_sequence():
     m_scratch_ref[batch_idx] = jnp.full(
@@ -480,6 +518,14 @@ def _flash_attention_kernel_single_batch(
       l_next_inv_safe = jnp.where(l_next == 0.0, 1.0, 1.0 / l_next)
       acc_scratch_ref[batch_idx] *= l_broadcast(l_corr * l_next_inv_safe)
       v = v_tile_ref[(*batch_idx, pl.dslice(start_k, block_k), slice(None))]
+      if dropout_rate > 0.0:  # paddle_tpu: drop probs, stats stay exact
+        keep = _dropout_keep_tile(
+            dropout_rate, seed_tile_ref[0],
+            _b_global, _h_global,
+            q_seq_idx * block_q,
+            kv_seq_idx * block_k_major + start_k,
+            (block_q, block_k))
+        p = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
       o_curr = jax.lax.dot(
           p.astype(v.dtype), v, preferred_element_type=jnp.float32
       )
@@ -501,7 +547,8 @@ def _flash_attention_kernel_single_batch_single_step(
     v_tile_ref,
     ab_tile_ref,
     q_segment_ids_tile_ref,
-    kv_segment_ids_tile_ref,  # Input arrays
+    kv_segment_ids_tile_ref,
+    seed_tile_ref,  # paddle_tpu: [1] int32 in SMEM (None without dropout)
     o_tile_ref,  # Output arrays
     l_ref: Any | None = None,
     m_ref: Any | None = None,
@@ -511,6 +558,7 @@ def _flash_attention_kernel_single_batch_single_step(
     block_k,
     kv_seq_len,
     mask_value,
+    dropout_rate=0.0,  # paddle_tpu
 ):
   block_k_major = k_tile_ref.shape[2]
   block_q = q_tile_ref.shape[2]
@@ -564,6 +612,14 @@ def _flash_attention_kernel_single_batch_single_step(
   if l_ref is not None:
     l_ref[batch_idx] = lax.broadcast_in_dim(l, l_ref.shape[2:], range(2))
 
+  if dropout_rate > 0.0:  # paddle_tpu: drop normalized probs
+    keep = _dropout_keep_tile(
+        dropout_rate, seed_tile_ref[0],
+        pl.program_id(0) * q_tile_ref.shape[0] + batch_idx[0],
+        pl.program_id(1),
+        pl.program_id(2) * block_q, 0, (block_q, block_k))
+    p = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
+
   v = v_tile_ref[batch_idx]
   o_tile_ref[batch_idx] = jax.lax.dot(
       p.astype(v.dtype), v, preferred_element_type=jnp.float32
@@ -613,6 +669,8 @@ def _flash_attention_impl(
     block_k_major,
     block_k,
     debug,
+    dropout_rate=0.0,  # paddle_tpu: in-kernel probs dropout
+    dropout_seed=None,  # paddle_tpu: int32 [1] array (traced per step)
 ):
   batch_size, num_heads, q_seq_len, head_dim = q.shape
   _, _, kv_seq_len, _ = k.shape
@@ -679,6 +737,7 @@ def _flash_attention_impl(
       sm_scale=sm_scale,
       block_k=block_k,
       kv_seq_len=kv_seq_len,
+      dropout_rate=dropout_rate,  # paddle_tpu
   )
   out_shape = jax.ShapeDtypeStruct(shape=q.shape, dtype=q.dtype)
   out_shape = [out_shape]
@@ -759,6 +818,14 @@ def _flash_attention_impl(
         ),
     )
 
+  # paddle_tpu: the per-step dropout seed rides in SMEM (None when off)
+  seed_spec = seed_arr = None
+  if dropout_rate > 0.0:
+    if dropout_seed is None:
+      raise ValueError("dropout_rate > 0 requires dropout_seed")
+    seed_arr = jnp.asarray(dropout_seed, jnp.int32).reshape((1,))
+    seed_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+
   in_specs = [
       pl.BlockSpec((block_b, 1, block_q, head_dim), q_index_map),
       pl.BlockSpec((block_b, 1, block_k_major, head_dim), kv_index_map),
@@ -766,6 +833,7 @@ def _flash_attention_impl(
       ab_block_spec,
       q_segment_ids_spec,
       kv_segment_ids_spec,
+      seed_spec,  # paddle_tpu
   ]
 
   o, *aux = pl.pallas_call(
@@ -799,7 +867,7 @@ def _flash_attention_impl(
           kernel_inputs_specs=(q, k, v, ab, q_segment_ids, kv_segment_ids),
           kernel_outputs_specs=out_shape,
       ),
-  )(q, k, v, ab, q_segment_ids, kv_segment_ids)
+  )(q, k, v, ab, q_segment_ids, kv_segment_ids, seed_arr)  # paddle_tpu
   if save_residuals:
     l, m = (v[..., 0] for v in aux[-2:])
     return (o, l, m)
@@ -814,6 +882,7 @@ def _flash_attention_dkv_kernel(
     ab_tile_ref,
     q_segment_ids_tile_ref,
     kv_segment_ids_tile_ref,
+    seed_tile_ref,  # paddle_tpu
     l_tile_ref,
     m_tile_ref,
     do_tile_ref,
@@ -829,12 +898,15 @@ def _flash_attention_dkv_kernel(
     q_seq_len: int,
     block_q: int,
     block_k: int,
+    dropout_rate: float = 0.0,  # paddle_tpu
 ):
   _, _, block_q_major, _ = q_tile_ref.shape
   _, _, block_k_major, _ = k_tile_ref.shape
 
   q_seq_index = pl.program_id(axis=3)
   kv_seq_index = pl.program_id(axis=2)
+  _b_global = pl.program_id(0)  # paddle_tpu: top-level read (see fwd note)
+  _h_global = pl.program_id(1)
 
   @pl.when(q_seq_index == 0)
   def start_new_sequence():
@@ -911,7 +983,19 @@ def _flash_attention_dkv_kernel(
       p = p * jnp.tile(
           1 / l, (1, block_k // MIN_BLOCK_SIZE)
       )  # [block_q_major, block_k_major]
-      dv = lax.dot(p.T.astype(do.dtype), do, preferred_element_type=jnp.float32)
+      if dropout_rate > 0.0:  # paddle_tpu: regenerate the fwd keep-mask
+        keep = _dropout_keep_tile(
+            dropout_rate, seed_tile_ref[0],
+            _b_global, _h_global,
+            q_seq_index * block_q_major + start_q,
+            kv_seq_index * block_k_major + start_k,
+            (block_q, block_k))
+        inv = 1.0 / (1.0 - dropout_rate)
+        pd = jnp.where(keep, p * inv, 0.0)
+      else:
+        keep, inv, pd = None, 1.0, p
+      dv = lax.dot(pd.T.astype(do.dtype), do,
+                   preferred_element_type=jnp.float32)
       dv_scratch_ref[pl.ds(start_k, block_k), :] += dv.astype(
           dv_scratch_ref.dtype
       )
@@ -922,6 +1006,8 @@ def _flash_attention_dkv_kernel(
       dp = lax.dot_general(
           do, v, TRANS_B_DIM_NUMBERS, preferred_element_type=jnp.float32
       )
+      if keep is not None:  # paddle_tpu: grad flows through the dropout
+        dp = jnp.where(keep, dp * inv, 0.0)
       ds = (dp - jnp.tile(di, (1, block_k // MIN_BLOCK_SIZE))) * p
 
       if sm_scale != 1.0:
@@ -971,6 +1057,8 @@ def _flash_attention_bwd_dkv(
     causal: bool = False,
     mask_value: float = DEFAULT_MASK_VALUE,
     debug: bool = False,
+    dropout_rate: float = 0.0,  # paddle_tpu
+    dropout_seed=None,  # paddle_tpu
 ):
   batch_size, num_heads, q_seq_len, head_dim = q.shape
   _, _, kv_seq_len, _ = k.shape
@@ -1092,6 +1180,13 @@ def _flash_attention_bwd_dkv(
         ),
     )
 
+  seed_spec = seed_arr = None  # paddle_tpu
+  if dropout_rate > 0.0:
+    if dropout_seed is None:
+      raise ValueError("dropout_rate > 0 requires dropout_seed")
+    seed_arr = jnp.asarray(dropout_seed, jnp.int32).reshape((1,))
+    seed_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+
   in_specs = [
       qo_spec,
       kv_spec,
@@ -1099,6 +1194,7 @@ def _flash_attention_bwd_dkv(
       dab_spec,
       q_segment_ids_spec,
       kv_segment_ids_spec,
+      seed_spec,  # paddle_tpu
       lm_spec,
       lm_spec,
       do_spec,
@@ -1129,6 +1225,7 @@ def _flash_attention_bwd_dkv(
       causal=causal,
       mask_value=mask_value,
       q_seq_len=q_seq_len,
+      dropout_rate=dropout_rate,  # paddle_tpu
   )
   name_scope = f"flash_mha_bwd_dkv_{block_q_major=}_{block_q=}_{block_k_major=}_{block_k=}"
   with jax.named_scope(name_scope):
@@ -1152,7 +1249,7 @@ def _flash_attention_bwd_dkv(
                     "arbitrary",
                 )
         ),
-    )(q, k, v, ab, q_segment_ids, kv_segment_ids, l, m, do, di)
+    )(q, k, v, ab, q_segment_ids, kv_segment_ids, seed_arr, l, m, do, di)  # paddle_tpu
     assert dk.shape == k.shape
     assert dv.shape == v.shape
   return dk, dv
@@ -1165,6 +1262,7 @@ def _flash_attention_dq_kernel(
     ab_tile_ref,
     q_segment_ids_tile_ref,
     kv_segment_ids_tile_ref,
+    seed_tile_ref,  # paddle_tpu
     l_tile_ref,
     m_tile_ref,
     do_tile_ref,
@@ -1178,12 +1276,15 @@ def _flash_attention_dq_kernel(
     mask_value: float,
     kv_seq_len: int,
     block_k: int,
+    dropout_rate: float = 0.0,  # paddle_tpu
 ):
   _, _, block_k_major, _ = k_tile_ref.shape
   _, _, block_q_major, _ = q_tile_ref.shape
 
   kv_seq_index = pl.program_id(axis=3)
   q_seq_index = pl.program_id(axis=2)
+  _b_global = pl.program_id(0)  # paddle_tpu: top-level read (see fwd note)
+  _h_global = pl.program_id(1)
 
   @pl.when(kv_seq_index == 0)
   def start_new_sequence():
@@ -1255,6 +1356,14 @@ def _flash_attention_dq_kernel(
         TRANS_B_DIM_NUMBERS,
         preferred_element_type=jnp.float32,
     )
+    if dropout_rate > 0.0:  # paddle_tpu: grad flows through the dropout
+      keep = _dropout_keep_tile(
+          dropout_rate, seed_tile_ref[0],
+          _b_global, _h_global,
+          q_seq_index * block_q_major,
+          kv_seq_index * block_k_major + i * block_k,
+          (block_q_major, block_k))
+      dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
     ds = (dp - jnp.tile(di, (1, block_k // MIN_BLOCK_SIZE))) * p
     # dp = jnp.dot(do, v.T)
     # ds = (dp - (dp * p).sum(axis=1)[:, None]) * p
@@ -1317,6 +1426,8 @@ def _flash_attention_bwd_dq(
     causal: bool,
     mask_value: float,
     debug: bool,
+    dropout_rate: float = 0.0,  # paddle_tpu
+    dropout_seed=None,  # paddle_tpu
 ):
   batch_size, num_heads, q_seq_len, head_dim = q.shape
   _, _, kv_seq_len, _ = k.shape
@@ -1434,6 +1545,13 @@ def _flash_attention_bwd_dq(
         ),
     )
 
+  seed_spec = seed_arr = None  # paddle_tpu
+  if dropout_rate > 0.0:
+    if dropout_seed is None:
+      raise ValueError("dropout_rate > 0 requires dropout_seed")
+    seed_arr = jnp.asarray(dropout_seed, jnp.int32).reshape((1,))
+    seed_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+
   in_specs = [
       qo_spec,
       kv_spec,
@@ -1441,6 +1559,7 @@ def _flash_attention_bwd_dq(
       dab_spec,
       q_segment_ids_spec,
       kv_segment_ids_spec,
+      seed_spec,  # paddle_tpu
       lm_spec,
       lm_spec,
       do_spec,
@@ -1465,6 +1584,7 @@ def _flash_attention_bwd_dq(
       mask_value=mask_value,
       block_k=block_k,  # type: ignore
       kv_seq_len=kv_seq_len,
+      dropout_rate=dropout_rate,  # paddle_tpu
   )
   name_scope = f"flash_mha_bwd_dq_{block_q_major=}_{block_k_major=}_{block_k=}"
   with jax.named_scope(name_scope):
@@ -1488,7 +1608,7 @@ def _flash_attention_bwd_dq(
                     "arbitrary",
                 )
         ),
-    )(q, k, v, ab, q_segment_ids, kv_segment_ids, l, m, do, di)
+    )(q, k, v, ab, q_segment_ids, kv_segment_ids, seed_arr, l, m, do, di)  # paddle_tpu
 
   # dab is just ds
   return dq, ds

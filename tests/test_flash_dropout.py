@@ -1,0 +1,162 @@
+"""In-kernel flash-attention dropout parity (r5).
+
+The vendored kernels drop the NORMALIZED probabilities with a keep-mask
+that is a pure coordinate hash (flash_attention._dropout_keep_tile), so a
+composed reference can regenerate the identical mask outside the kernel
+and the full forward AND backward must agree elementwise — executed here
+through the real kernel bodies in Pallas interpret mode on CPU.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import attention_ops as ao
+from paddle_tpu.ops.pallas_kernels import flash_attention as fa
+
+
+@pytest.fixture(autouse=True)
+def _interpret_kernels():
+    fa.INTERPRET = True
+    yield
+    fa.INTERPRET = False
+
+
+def _full_keep_mask(rate, seed, b, h, sq, sk):
+    """The mask the kernels generate, computed in one shot per (b, h)."""
+    rows = []
+    for bi in range(b):
+        heads = []
+        for hi in range(h):
+            heads.append(fa._dropout_keep_tile(rate, seed, bi, hi, 0, 0,
+                                               (sq, sk)))
+        rows.append(jnp.stack(heads))
+    return jnp.stack(rows)  # [b, h, sq, sk] bool
+
+
+def _composed(q, k, v, keep, causal, sm_scale, rate):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        sq, sk = s.shape[-2:]
+        cm = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(cm, s, fa.DEFAULT_MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    pd = jnp.where(keep, p * (1.0 / (1.0 - rate)), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", pd, v.astype(jnp.float32))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_dropout_forward_matches_composed(rng, causal):
+    b, h, s, d = 1, 2, 256, 64
+    rate, sm_scale = 0.2, 0.125
+    q = jnp.asarray(rng.randn(b, h, s, d).astype("float32"))
+    k = jnp.asarray(rng.randn(b, h, s, d).astype("float32"))
+    v = jnp.asarray(rng.randn(b, h, s, d).astype("float32"))
+    seed = jnp.asarray([1234], jnp.int32)
+    o = ao._flash_dropout(q, k, v, seed, causal, sm_scale, rate)
+    keep = _full_keep_mask(rate, 1234, b, h, s, s)
+    ref = _composed(q, k, v, keep, causal, sm_scale, rate)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_dropout_grads_match_composed(rng, causal):
+    """Drive the custom-vjp backward EAGERLY (interpret-mode pallas_calls
+    cannot be traced on CPU — same constraint as test_ring_flash_parity)
+    and compare against jax.grad of the composed reference."""
+    b, h, s, d = 1, 2, 256, 64
+    rate, sm_scale = 0.15, 0.125
+    q = jnp.asarray(rng.randn(b, h, s, d).astype("float32"))
+    k = jnp.asarray(rng.randn(b, h, s, d).astype("float32"))
+    v = jnp.asarray(rng.randn(b, h, s, d).astype("float32"))
+    seed = jnp.asarray([77], jnp.int32)
+    do = jnp.asarray(rng.randn(b, h, s, d).astype("float32"))
+
+    _, res = ao._flash_dropout_fwd(q, k, v, seed, causal, sm_scale, rate)
+    dq, dk, dv, _ = ao._flash_dropout_bwd(causal, sm_scale, rate, res, do)
+
+    keep = _full_keep_mask(rate, 77, b, h, s, s)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_composed(q, k, v, keep, causal, sm_scale, rate) * do)
+
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, nm in zip((dq, dk, dv), g2, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=3e-4, atol=3e-4, err_msg=nm)
+
+
+def test_flash_dropout_mask_properties(rng):
+    """Keep-rate ~= 1-rate; masks differ across seeds and (b, h)."""
+    rate = 0.25
+    m1 = np.asarray(fa._dropout_keep_tile(rate, 1, 0, 0, 0, 0, (512, 512)))
+    m2 = np.asarray(fa._dropout_keep_tile(rate, 2, 0, 0, 0, 0, (512, 512)))
+    m3 = np.asarray(fa._dropout_keep_tile(rate, 1, 0, 1, 0, 0, (512, 512)))
+    assert abs(m1.mean() - 0.75) < 0.01
+    assert (m1 != m2).mean() > 0.2
+    assert (m1 != m3).mean() > 0.2
+    # tile-partition independence: quarter-tiles reassemble the full mask
+    q1 = np.asarray(fa._dropout_keep_tile(rate, 1, 0, 0, 0, 256, (512, 256)))
+    np.testing.assert_array_equal(m1[:, 256:], q1)
+
+
+def test_flash_dropout_rate_zero_matches_plain(rng):
+    b, h, s, d = 1, 1, 256, 64
+    q = jnp.asarray(rng.randn(b, h, s, d).astype("float32"))
+    k = jnp.asarray(rng.randn(b, h, s, d).astype("float32"))
+    v = jnp.asarray(rng.randn(b, h, s, d).astype("float32"))
+    o_plain, _, _ = fa._flash_attention_impl(
+        q, k, v, None, None, True, False, 0.125, 1, 128, 128, 128, False)
+    keep = _full_keep_mask(0.0, 9, b, h, s, s)
+    ref = _composed(q, k, v, keep, False, 0.125, 0.0)
+    np.testing.assert_allclose(np.asarray(o_plain), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_dropout_multi_tile_parity(rng, causal):
+    """Multi-tile coverage (2x2 q/k blocks, b=2, h=2): an offset mistake in
+    any kernel's _dropout_keep_tile call would only show on non-first tiles
+    or non-zero batch/head — drive the impl/bwd entries directly with block
+    128 over s=256 so every coordinate term is nonzero somewhere."""
+    b, h, s, d = 2, 2, 256, 64
+    rate, sm_scale, blk = 0.2, 0.125, 128
+    q = jnp.asarray(rng.randn(b, h, s, d).astype("float32"))
+    k = jnp.asarray(rng.randn(b, h, s, d).astype("float32"))
+    v = jnp.asarray(rng.randn(b, h, s, d).astype("float32"))
+    seed_arr = jnp.asarray([991], jnp.int32)
+    o, l, m = fa._flash_attention_impl(
+        q, k, v, None, None, True, causal, sm_scale, 1, blk, blk, blk, False,
+        dropout_rate=rate, dropout_seed=seed_arr)
+    keep = _full_keep_mask(rate, 991, b, h, s, s)
+    ref = _composed(q, k, v, keep, causal, sm_scale, rate)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    do = jnp.asarray(rng.randn(b, h, s, d).astype("float32"))
+    di = jnp.sum(o.astype(jnp.float32) * do, axis=-1)
+    dk_f, dv_f = fa._flash_attention_bwd_dkv(
+        q, k, v, None, None, l, m, do, di,
+        block_q_major=blk, block_q=blk, block_k_major=blk, block_k=blk,
+        sm_scale=sm_scale, causal=causal,
+        mask_value=fa.DEFAULT_MASK_VALUE, debug=False,
+        dropout_rate=rate, dropout_seed=seed_arr)
+    dq_f, _ = fa._flash_attention_bwd_dq(
+        q, k, v, None, None, l, m, do, di,
+        block_q_major=blk, block_k_major=blk, block_k=blk,
+        sm_scale=sm_scale, causal=causal,
+        mask_value=fa.DEFAULT_MASK_VALUE, debug=False,
+        dropout_rate=rate, dropout_seed=seed_arr)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_composed(q, k, v, keep, causal, sm_scale, rate) * do)
+
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, nm in zip((dq_f, dk_f, dv_f), g2, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=3e-4, atol=3e-4, err_msg=nm)
