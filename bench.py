@@ -780,7 +780,7 @@ def bench_longseq_train(batch=8, seq=2048, vocab=32000, skip=3, iters=10,
     is 0 here (the modern long-context recipe); the r5 in-kernel dropout
     path supports it at ~7% step cost (22.5 vs 24.2 ex/s measured) where
     the composed path would need a 12.9 GB probs materialization. Measured
-    r5: 0.35 MFU (vs 0.30 bar; benchmarks/TRANSFORMER_PROFILE.md §5)."""
+    r5: 0.37 MFU (vs 0.30 bar; benchmarks/TRANSFORMER_PROFILE.md §5)."""
     import paddle_tpu as fluid
     from paddle_tpu.models import transformer as tfm
 

