@@ -1124,7 +1124,21 @@ def main():
     detail = {"device": kind}
 
     batch, seq, vocab = 64, 256, 30000
-    tfm_eps, tfm_sps = bench_transformer(batch, seq, vocab, use_amp=True)
+    # the axon compile tunnel occasionally drops a connection mid-compile;
+    # one retry keeps that transient flake from sinking the whole headline
+    # metric — but ONLY for connection-type failures, so a real numeric or
+    # compile regression still fails loudly instead of being healed
+    try:
+        tfm_eps, tfm_sps = bench_transformer(batch, seq, vocab, use_amp=True)
+    except Exception as first_err:
+        msg = repr(first_err)
+        if not any(s in msg for s in ("response body closed", "remote_compile",
+                                      "Connection", "DEADLINE")):
+            raise
+        sys.stderr.write("transformer bench hit a tunnel flake (%r); "
+                         "retrying once\n" % (first_err,))
+        time.sleep(20)
+        tfm_eps, tfm_sps = bench_transformer(batch, seq, vocab, use_amp=True)
     detail["transformer_bf16"] = {
         "examples_per_sec": round(tfm_eps, 2), "steps_per_sec": round(tfm_sps, 3),
         **_last_spread()}
