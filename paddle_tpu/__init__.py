@@ -30,6 +30,7 @@ from . import (  # noqa: F401
     layers,
     log,
     metrics,
+    monitor,
     nets,
     optimizer,
     parallel,

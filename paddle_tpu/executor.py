@@ -16,7 +16,9 @@ requirement), and scope handling mirror ``python/paddle/fluid/executor.py``.
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -32,8 +34,85 @@ from .core.interpreter import run_block_ops
 from .core.place import Place, get_device
 from .core.registry import OpContext, get_op_impl
 from .core.scope import Scope, global_scope
+from .monitor import GRAD_NORM_VAR, metrics as _mx, tracer as _tr
 
 __all__ = ["Executor", "TraceContext"]
+
+# Instruments are module-level handles: looked up once, so the per-run cost
+# with metrics ON is a few lock+add ops, and with metrics OFF a single
+# branch inside each instrument call (no lock, no allocation) — the
+# acceptance bar for the hot path.
+_m_runs = _mx.counter("executor/runs", help="Executor.run invocations")
+_m_cache_hit = _mx.counter("executor/cache_hit",
+                           help="program-cache hits (reused _CompiledStep)")
+_m_cache_miss = _mx.counter("executor/cache_miss",
+                            help="program-cache misses (new specialization)")
+_m_step_ms = _mx.histogram("executor/step_time_ms",
+                           help="wall time of one cached step dispatch")
+_m_compile_ms = _mx.histogram(
+    "executor/compile_time_ms",
+    help="trace+XLA-compile wall time of a cache-miss first step")
+_m_trace_ms = _mx.histogram(
+    "executor/trace_setup_ms",
+    help="host time to build a _CompiledStep specialization")
+_m_feed_bytes = _mx.counter("executor/feed_bytes",
+                            help="bytes handed to the step as feeds")
+_m_fetch_bytes = _mx.counter("executor/fetch_bytes",
+                             help="bytes fetched back to host")
+_m_hbm_used = _mx.gauge("device/hbm_bytes_in_use",
+                        help="memory_stats bytes_in_use, summed over devices")
+_m_hbm_limit = _mx.gauge("device/hbm_bytes_limit",
+                         help="memory_stats bytes_limit, summed over devices")
+_m_grad_norm = _mx.gauge("optimizer/grad_global_norm",
+                         help="pre-clip global grad norm (PADDLE_TPU_GRAD_NORM=1)")
+
+_mem_stats_ok: Optional[bool] = None  # None = not probed yet
+_HBM_SAMPLE_EVERY = 32  # sample memory_stats on miss + every Nth run
+
+
+_mem_devices = None  # cached jax.local_devices() once the probe succeeds
+
+
+def _update_hbm_gauges() -> None:
+    """Refresh HBM gauges from device memory_stats(); probes capability once
+    (CPU backends may not implement it) and then never raises per step."""
+    global _mem_stats_ok, _mem_devices
+    if _mem_stats_ok is False:
+        return
+    try:
+        if _mem_devices is None:
+            _mem_devices = jax.local_devices()
+        used = limit = 0
+        got = False
+        for d in _mem_devices:
+            stats = d.memory_stats()
+            if not stats:
+                continue
+            got = True
+            used += stats.get("bytes_in_use", 0)
+            limit += stats.get("bytes_limit", 0)
+        if not got:
+            _mem_stats_ok = False
+            return
+        _mem_stats_ok = True
+        _m_hbm_used.set(used)
+        if limit:
+            _m_hbm_limit.set(limit)
+    except Exception:
+        _mem_stats_ok = False
+
+
+_NULL_CTX = contextlib.nullcontext()
+
+
+def _nbytes(arrays) -> int:
+    total = 0
+    for a in arrays:
+        nb = getattr(a, "nbytes", None)
+        if nb is None:
+            nb = np.asarray(a).nbytes
+        total += nb
+    return total
 
 _UserCompiledProgram = None  # lazily bound CompiledProgram class (import cycle)
 
@@ -430,6 +509,18 @@ class Executor:
         fetch_names = self._fetch_names(fetch_list)
 
         block = program.global_block
+        # hot-path guards read the module flags directly: with metrics and
+        # tracing both off, the whole observability layer costs these two
+        # attribute loads + branches per run — no lock, no allocation
+        mx_on = _mx._enabled
+        tr_on = _tr._active
+        # Opt-in grad-norm gauge: the probe var is non-persistable (kept out
+        # of checkpoints and the state signature), so it reaches the host as
+        # a hidden extra fetch appended to the user's fetch list.
+        grad_norm_fetch = (mx_on and GRAD_NORM_VAR in block.vars
+                           and GRAD_NORM_VAR not in fetch_names)
+        run_fetch_names = (fetch_names + (GRAD_NORM_VAR,)
+                           if grad_norm_fetch else fetch_names)
         feeds = {}
         feed_sig = []
         for name in sorted(feed):
@@ -466,31 +557,43 @@ class Executor:
             id(program),
             program._version,
             tuple(feed_sig),
-            fetch_names,
+            run_fetch_names,
             avail_state_names,
             is_test,
             id(mesh) if mesh is not None else None,
             accumulation_steps,
         )
         compiled = self._cache.get(key) if use_program_cache else None
+        was_miss = compiled is None
         if compiled is None:
             from .log import vlog
 
             vlog(1, "Executor: compiling new step specialization "
                     "(program v%s, %d feeds, fetch=%s, test=%s)",
                  program._version, len(feed_sig), list(fetch_names), is_test)
-            compiled = _CompiledStep(
-                program,
-                tuple(sorted(feeds)),
-                fetch_names,
-                state_names,
-                is_test=is_test,
-                jit=is_training_or_has_feed,
-                mesh=mesh,
-                accumulation_steps=accumulation_steps,
-            )
+            if mx_on:
+                _m_cache_miss.inc()
+            t_build = time.perf_counter() if mx_on else 0.0
+            with _tr.span("executor/trace_setup", cat="executor",
+                          args={"program_version": program._version,
+                                "n_feeds": len(feed_sig)}) if tr_on \
+                    else _NULL_CTX:
+                compiled = _CompiledStep(
+                    program,
+                    tuple(sorted(feeds)),
+                    run_fetch_names,
+                    state_names,
+                    is_test=is_test,
+                    jit=is_training_or_has_feed,
+                    mesh=mesh,
+                    accumulation_steps=accumulation_steps,
+                )
+            if mx_on:
+                _m_trace_ms.observe((time.perf_counter() - t_build) * 1e3)
             if use_program_cache:
                 self._cache[key] = compiled
+        elif mx_on:
+            _m_cache_hit.inc()
 
         rng_key = self._rng_key(program)
         if mesh is not None:
@@ -520,7 +623,36 @@ class Executor:
                 feeds = {k: v if isinstance(v, jax.Array) and dev in v.devices()
                          else jax.device_put(v, dev)
                          for k, v in feeds.items()}
-        new_state, fetches = compiled(state, feeds, rng_key)
+        t_step = time.perf_counter() if mx_on else 0.0
+        if tr_on:
+            with _tr.span("executor/compile_and_step" if was_miss
+                          else "executor/step", cat="executor"):
+                new_state, fetches = compiled(state, feeds, rng_key)
+        else:
+            new_state, fetches = compiled(state, feeds, rng_key)
+        if mx_on:
+            # A cache-miss first call pays jit trace + XLA compile; report it
+            # separately so the steady-state step histogram stays clean. On
+            # async backends the hit-path number is dispatch wall time (add
+            # FLAGS_benchmark for a per-step device sync).
+            dt_ms = (time.perf_counter() - t_step) * 1e3
+            (_m_compile_ms if was_miss else _m_step_ms).observe(dt_ms)
+            _m_runs.inc()
+            if feeds:
+                _m_feed_bytes.inc(_nbytes(feeds.values()))
+            # HBM gauges are a coarse signal; sampling on miss + every Nth
+            # run keeps the per-device memory_stats() calls off the
+            # steady-state dispatch path
+            if was_miss or int(_m_runs.value) % _HBM_SAMPLE_EVERY == 0:
+                _update_hbm_gauges()
+        if grad_norm_fetch:
+            # opt-in (PADDLE_TPU_GRAD_NORM=1 at graph-build time): one
+            # scalar device sync per step
+            try:
+                _m_grad_norm.set(float(np.asarray(fetches[-1])))
+            except (TypeError, ValueError):
+                pass
+            fetches = fetches[:-1]
 
         if _flags.benchmark:
             # per-step device sync (reference: FLAGS_benchmark operator.cc:942)
@@ -542,8 +674,12 @@ class Executor:
         if not fetch_names:
             return []
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return list(fetches)
+            out = [np.asarray(f) for f in fetches]
+        else:
+            out = list(fetches)
+        if mx_on and out:
+            _m_fetch_bytes.inc(_nbytes(out))
+        return out
 
     # Fluid parity alias
     def infer_from_program(self, *a, **kw):

@@ -11,8 +11,9 @@ from __future__ import annotations
 import logging
 import os
 import sys
+from typing import Optional
 
-__all__ = ["get_logger", "vlog", "vlog_level"]
+__all__ = ["get_logger", "vlog", "vlog_level", "set_vlog_level"]
 
 _root = logging.getLogger("paddle_tpu")
 if not _root.handlers:
@@ -22,12 +23,24 @@ if not _root.handlers:
     _root.addHandler(h)
     _root.setLevel(logging.INFO)
 
+_vlog_level: Optional[int] = None  # parsed once; vlog() sits on hot paths
+
 
 def vlog_level() -> int:
-    try:
-        return int(os.environ.get("GLOG_v", "0"))
-    except ValueError:
-        return 0
+    global _vlog_level
+    if _vlog_level is None:
+        try:
+            _vlog_level = int(os.environ.get("GLOG_v", "0"))
+        except ValueError:
+            _vlog_level = 0
+    return _vlog_level
+
+
+def set_vlog_level(level: Optional[int]) -> None:
+    """Override (or with ``None``, re-read from ``GLOG_v``) the cached
+    verbosity — for tests and runtime toggling."""
+    global _vlog_level
+    _vlog_level = None if level is None else int(level)
 
 
 def get_logger(name: str = "") -> logging.Logger:
@@ -35,6 +48,10 @@ def get_logger(name: str = "") -> logging.Logger:
 
 
 def vlog(level: int, msg: str, *args):
-    """reference: VLOG(level) << ... — prints iff GLOG_v >= level."""
+    """reference: VLOG(level) << ... — prints iff GLOG_v >= level.
+
+    ``msg`` is %-formatted against ``args`` only when the level is active;
+    a literal ``%`` in a no-args message is safe (the level prefix is a
+    separate format field, never concatenated into user text)."""
     if vlog_level() >= level:
-        _root.info("[VLOG%d] " + msg, level, *args)
+        _root.info("[VLOG%d] %s", level, (msg % args) if args else msg)

@@ -1,0 +1,61 @@
+"""paddle_tpu.monitor — unified metrics + host-span tracing.
+
+The observability layer the Fluid reference spreads over RecordEvent,
+the CUPTI DeviceTracer, ``tools/timeline.py`` and ad-hoc VLOGs, rebuilt
+TPU-native in three pieces:
+
+* :mod:`~paddle_tpu.monitor.metrics` — process-global registry of
+  counters / gauges / fixed-bucket histograms. ``PADDLE_TPU_METRICS=0``
+  disables it (hot paths then pay a single branch). The Executor, readers
+  and optimizer are pre-instrumented; ``monitor.snapshot()`` returns
+  everything as a dict, ``monitor.to_text()`` as a table.
+* :mod:`~paddle_tpu.monitor.tracer` — nested host wall-clock spans with
+  Chrome-trace/Perfetto export. ``PADDLE_TPU_TRACE_FILE=/tmp/t.json``
+  records for the whole process and writes the trace at exit; it composes
+  with the ``jax.profiler`` device trace via ``profiler.record_event`` /
+  ``span(..., device=True)``.
+* :mod:`~paddle_tpu.monitor.step_logger` — ``StepLogger``, the periodic
+  throughput/step-time/loss line emitter used by ``bench.py`` and
+  ``train/``; its ``summary()`` is the ``metrics`` section of bench JSON.
+
+Quick tour::
+
+    from paddle_tpu import monitor
+
+    monitor.tracer.start_tracing()
+    for batch in data:
+        exe.run(main, feed=batch, fetch_list=[loss])
+    print(monitor.to_text())                       # cache hits, step times…
+    monitor.tracer.stop_tracing("/tmp/trace.json")  # open in chrome://tracing
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import metrics, tracer  # noqa: F401
+from .metrics import (  # noqa: F401
+    counter, gauge, histogram, enabled, enable, disable,
+    snapshot, to_json, to_text, reset,
+)
+from .step_logger import StepLogger  # noqa: F401
+
+__all__ = [
+    "metrics", "tracer", "StepLogger",
+    "counter", "gauge", "histogram", "enabled", "enable", "disable",
+    "snapshot", "to_json", "to_text", "reset",
+    "GRAD_NORM_VAR", "grad_norm_enabled",
+]
+
+# Name of the (non-persistable — never checkpointed) program var the
+# optimizer writes the pre-clip global gradient norm into when grad-norm
+# monitoring is on; the Executor fetches it as a hidden extra and mirrors
+# it into the "optimizer/grad_global_norm" gauge post-step.
+GRAD_NORM_VAR = "@grad_global_norm@"
+
+
+def grad_norm_enabled() -> bool:
+    """Opt-in (env ``PADDLE_TPU_GRAD_NORM=1``): reading the norm gauge
+    forces one scalar device sync per step, so it is off by default."""
+    return os.environ.get("PADDLE_TPU_GRAD_NORM", "").strip().lower() in (
+        "1", "true", "yes", "on")

@@ -1,0 +1,131 @@
+"""StepLogger: periodic throughput/step-time/loss lines + a summary dict.
+
+The step-callback layer bench.py and train/ scripts share: call ``step()``
+once per training step and every ``every_n`` steps one line goes to the
+``paddle_tpu`` logger (stderr by default):
+
+    [train] step 200 | 31.9 steps/s | 2041 ex/s | step 31.3ms p50 31.1 p95 34.8 | loss 2.3127
+
+``summary()`` returns the same numbers as a dict — the ``metrics`` section
+benchmark JSON embeds. Step times also feed the registry histogram
+``step_logger/step_time_ms`` so ``monitor.snapshot()`` sees them without
+holding a StepLogger reference.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..log import get_logger
+from . import metrics as _metrics
+
+__all__ = ["StepLogger"]
+
+
+class StepLogger:
+    def __init__(self, every_n: int = 10, name: str = "train", logger=None,
+                 keep_last: int = 4096):
+        self.every_n = max(1, int(every_n))
+        self.name = name
+        self._log = logger or get_logger("monitor")
+        self._keep_last = max(16, int(keep_last))
+        self._hist = _metrics.histogram(
+            "step_logger/step_time_ms", help="wall time between step() calls")
+        self.reset()
+
+    def reset(self) -> None:
+        self._steps = 0
+        self._examples = 0.0
+        self._last_loss: Optional[float] = None
+        self._pending_loss = None
+        self._t_start: Optional[float] = None
+        self._t_last: Optional[float] = None
+        self._times_ms = []  # recent step times, bounded by keep_last
+        self._win_t0: Optional[float] = None  # current reporting window
+        self._win_steps = 0
+        self._win_examples = 0.0
+
+    # -- the per-step callback ------------------------------------------------
+    def step(self, loss=None, examples: float = 0.0) -> None:
+        """Record one finished step. ``loss`` may be a float, numpy scalar,
+        or device array (converted only when a log line is due, to avoid a
+        per-step device sync)."""
+        now = time.perf_counter()
+        if self._t_start is None:
+            self._t_start = self._win_t0 = now
+        else:
+            dt_ms = (now - self._t_last) * 1e3
+            self._times_ms.append(dt_ms)
+            if len(self._times_ms) > self._keep_last:
+                del self._times_ms[: -self._keep_last]
+            self._hist.observe(dt_ms)
+        self._t_last = now
+        self._steps += 1
+        self._examples += examples
+        self._win_steps += 1
+        self._win_examples += examples
+        if loss is not None:
+            self._pending_loss = loss
+        if self._steps % self.every_n == 0:
+            self._emit(now)
+
+    def _emit(self, now: float) -> None:
+        win_dt = max(now - (self._win_t0 or now), 1e-9)
+        sps = self._win_steps / win_dt
+        parts = ["[%s] step %d" % (self.name, self._steps),
+                 "%.1f steps/s" % sps]
+        if self._win_examples:
+            parts.append("%.0f ex/s" % (self._win_examples / win_dt))
+        if self._times_ms:
+            recent = sorted(self._times_ms[-self._keep_last:])
+            parts.append("step %.1fms p50 %.1f p95 %.1f"
+                         % (self._times_ms[-1],
+                            _metrics.sorted_percentile(recent, 50),
+                            _metrics.sorted_percentile(recent, 95)))
+        loss = self._pending_loss
+        if loss is not None:
+            try:
+                self._last_loss = float(loss)
+                parts.append("loss %.4f" % self._last_loss)
+            except (TypeError, ValueError):
+                pass
+        self._log.info(" | ".join(parts))
+        self._win_t0 = now
+        self._win_steps = 0
+        self._win_examples = 0.0
+
+    # -- the bench surface ----------------------------------------------------
+    def summary(self) -> dict:
+        """Totals + step-time percentiles as a plain dict (bench JSON
+        ``metrics`` section)."""
+        elapsed = ((self._t_last - self._t_start)
+                   if self._t_start is not None and self._t_last is not None
+                   else 0.0)
+        out = {
+            "steps": self._steps,
+            "examples": self._examples,
+            "elapsed_sec": round(elapsed, 4),
+        }
+        if elapsed > 0:
+            out["steps_per_sec"] = round((self._steps - 1) / elapsed, 3)
+            if self._examples:
+                per_step = self._examples / max(self._steps, 1)
+                out["examples_per_sec"] = round(
+                    (self._steps - 1) * per_step / elapsed, 2)
+        if self._times_ms:
+            ts = sorted(self._times_ms)
+            out["step_time_ms"] = {
+                "mean": round(sum(ts) / len(ts), 3),
+                "p50": round(_metrics.sorted_percentile(ts, 50), 3),
+                "p95": round(_metrics.sorted_percentile(ts, 95), 3),
+                "max": round(ts[-1], 3),
+            }
+        if self._last_loss is None and self._pending_loss is not None:
+            try:
+                self._last_loss = float(self._pending_loss)
+            except (TypeError, ValueError):
+                pass
+        if self._last_loss is not None:
+            out["last_loss"] = self._last_loss
+        return out

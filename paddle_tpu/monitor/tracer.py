@@ -1,0 +1,259 @@
+"""Host-span tracer: nested wall-clock spans → Chrome-trace/Perfetto JSON.
+
+The role the reference splits between ``platform/profiler.cc`` RecordEvent
+and ``tools/timeline.py`` (CUPTI → chrome://tracing converter): record
+named, nested host spans with microsecond timestamps and export them as a
+``chrome://tracing`` / Perfetto-loadable JSON — no TensorBoard required.
+It composes with the existing ``jax.profiler`` device trace: spans opened
+with ``device=True`` (and ``profiler.record_event``) also enter a
+``jax.profiler.TraceAnnotation`` so the same name shows up in the XLA
+device timeline when one is being captured.
+
+Activation: ``start_tracing()`` explicitly, or set ``PADDLE_TPU_TRACE_FILE``
+— tracing then starts at import and the Chrome trace is written to that
+path at interpreter exit. Hot paths guard on ``active()`` (a single module
+bool read) so an idle tracer costs one branch.
+
+Two file formats:
+
+* **raw spans** (``save_spans``): ``{"schema": "paddle_tpu.host_spans/v1",
+  "spans": [{name, cat, ts_us, dur_us, pid, tid, args}]}`` — the stable
+  interchange format ``tools/dump_metrics.py`` converts from.
+* **Chrome trace** (``save_chrome_trace`` / ``to_chrome_trace``): complete
+  ("ph": "X") events under ``traceEvents``, plus process/thread metadata.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "span", "start_tracing", "stop_tracing", "active", "get_spans",
+    "clear_spans", "save_spans", "load_spans", "to_chrome_trace",
+    "save_chrome_trace", "SPAN_SCHEMA",
+]
+
+SPAN_SCHEMA = "paddle_tpu.host_spans/v1"
+
+_active: bool = False
+_spans: List[Dict[str, Any]] = []
+_spans_lock = threading.Lock()
+_tls = threading.local()  # per-thread nesting depth
+_trace_file: Optional[str] = None
+
+# Whole-process tracing (PADDLE_TPU_TRACE_FILE) on a long-running job must
+# not grow memory without bound: past this cap new spans are dropped (count
+# kept) and a single warning is logged. Override with
+# PADDLE_TPU_TRACE_MAX_SPANS.
+_max_spans: int = int(os.environ.get("PADDLE_TPU_TRACE_MAX_SPANS", "1000000"))
+_dropped: int = 0
+
+
+def active() -> bool:
+    return _active
+
+
+def start_tracing() -> None:
+    """Begin recording host spans (idempotent; keeps prior spans)."""
+    global _active
+    _active = True
+
+
+def stop_tracing(path: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Stop recording; optionally write the Chrome trace to ``path``.
+    Returns the recorded spans (still held — ``clear_spans()`` drops them)."""
+    global _active
+    _active = False
+    spans = get_spans()
+    if path:
+        save_chrome_trace(path, spans)
+    return spans
+
+
+def get_spans() -> List[Dict[str, Any]]:
+    with _spans_lock:
+        return list(_spans)
+
+
+def clear_spans() -> None:
+    global _dropped
+    with _spans_lock:
+        _spans.clear()
+        _dropped = 0
+
+
+def _record(name: str, cat: str, t0_us: int, dur_us: int,
+            args: Optional[dict], depth: int = 0) -> None:
+    rec = {
+        "name": name,
+        "cat": cat,
+        "ts_us": t0_us,
+        "dur_us": dur_us,
+        "pid": os.getpid(),
+        "tid": threading.get_ident(),
+        "depth": depth,
+    }
+    if args:
+        rec["args"] = args
+    global _dropped
+    with _spans_lock:
+        if len(_spans) >= _max_spans:
+            _dropped += 1
+            just_hit = _dropped == 1
+        else:
+            _spans.append(rec)
+            just_hit = False
+    if just_hit:
+        import logging
+
+        logging.getLogger("paddle_tpu").warning(
+            "monitor.tracer: span buffer full (%d spans); further spans are "
+            "dropped — raise PADDLE_TPU_TRACE_MAX_SPANS or scope tracing "
+            "with start_tracing()/stop_tracing()", _max_spans)
+
+
+@contextlib.contextmanager
+def span(name: str, cat: str = "host", args: Optional[dict] = None,
+         device: bool = False):
+    """Record a nested wall-clock span.
+
+    ``device=True`` additionally enters ``jax.profiler.TraceAnnotation`` so
+    the span lands in an active XLA device trace too (the record_event
+    composition). Nesting is implicit — Chrome's trace viewer stacks
+    overlapping complete events per (pid, tid) by time containment.
+    """
+    if not _active and not device:
+        yield
+        return
+    ann = None
+    if device:
+        try:
+            import jax
+
+            ann = jax.profiler.TraceAnnotation(name)
+            ann.__enter__()
+        except Exception:
+            ann = None
+    depth = getattr(_tls, "depth", 0)
+    _tls.depth = depth + 1
+    t0 = time.perf_counter_ns()
+    try:
+        yield
+    finally:
+        dur = time.perf_counter_ns() - t0
+        _tls.depth = depth
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        if _active:
+            _record(name, cat, t0 // 1000, max(1, dur // 1000), args, depth)
+
+
+def instant(name: str, cat: str = "host", args: Optional[dict] = None) -> None:
+    """Zero-duration marker (rendered as an instant event)."""
+    if not _active:
+        return
+    _record(name, cat, time.perf_counter_ns() // 1000, 0, args)
+
+
+__all__.append("instant")
+
+
+# -- serialization ------------------------------------------------------------
+
+def save_spans(path: str, spans: Optional[List[dict]] = None) -> str:
+    """Write the raw host-span interchange file (see module docstring)."""
+    doc = {"schema": SPAN_SCHEMA, "spans": spans if spans is not None else get_spans()}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def load_spans(path: str) -> List[dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and doc.get("schema") == SPAN_SCHEMA:
+        return list(doc.get("spans", []))
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        # accept a Chrome trace back (the dump_metrics round-trip): complete
+        # events AND instant markers survive; only metadata ("M") is
+        # regenerated on the next export
+        spans = []
+        for ev in doc["traceEvents"]:
+            if ev.get("ph") not in ("X", "i", "I"):
+                continue
+            spans.append({
+                "name": ev.get("name", ""), "cat": ev.get("cat", "host"),
+                "ts_us": int(ev.get("ts", 0)), "dur_us": int(ev.get("dur", 0)),
+                "pid": ev.get("pid", 0), "tid": ev.get("tid", 0),
+                **({"args": ev["args"]} if ev.get("args") else {}),
+            })
+        return spans
+    raise ValueError("%s: not a %s or Chrome-trace file" % (path, SPAN_SCHEMA))
+
+
+def to_chrome_trace(spans: Optional[List[dict]] = None) -> dict:
+    """Spans → ``chrome://tracing`` JSON object (the ``tools/timeline.py``
+    output format: ``traceEvents`` complete events + metadata)."""
+    spans = spans if spans is not None else get_spans()
+    events: List[dict] = []
+    seen_threads = set()
+    for s in spans:
+        pid, tid = s.get("pid", 0), s.get("tid", 0)
+        if (pid, tid) not in seen_threads:
+            seen_threads.add((pid, tid))
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": tid, "args": {"name": "host-thread-%s" % tid}})
+        ev = {
+            "ph": "X" if s.get("dur_us", 0) else "i",
+            "name": s.get("name", ""),
+            "cat": s.get("cat", "host"),
+            "ts": s.get("ts_us", 0),
+            "pid": pid,
+            "tid": tid,
+        }
+        if s.get("dur_us", 0):
+            ev["dur"] = s["dur_us"]
+        else:
+            ev["s"] = "t"  # instant scope: thread
+        if s.get("args"):
+            ev["args"] = s["args"]
+        events.append(ev)
+    for pid in {s.get("pid", 0) for s in spans}:
+        events.append({"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+                       "args": {"name": "paddle_tpu host"}})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"producer": "paddle_tpu.monitor.tracer"}}
+
+
+def save_chrome_trace(path: str, spans: Optional[List[dict]] = None) -> str:
+    with open(path, "w") as f:
+        json.dump(to_chrome_trace(spans), f)
+    return path
+
+
+# -- env activation -----------------------------------------------------------
+
+def _maybe_autostart() -> None:
+    global _trace_file
+    path = os.environ.get("PADDLE_TPU_TRACE_FILE", "").strip()
+    if not path:
+        return
+    _trace_file = path
+    start_tracing()
+
+    @atexit.register
+    def _flush():  # pragma: no cover — exercised via subprocess in tests
+        if get_spans():
+            try:
+                save_chrome_trace(_trace_file)
+            except OSError:
+                pass
+
+
+_maybe_autostart()

@@ -148,9 +148,46 @@ class Optimizer:
     def apply_gradients(self, params_grads: List[Tuple[Parameter, Variable]]):
         """reference: optimizer.py:318 — clip, regularize, then optimize ops."""
         params_grads = sorted(params_grads, key=lambda pg: pg[0].name)
+        from . import monitor as _monitor
+
+        if _monitor.grad_norm_enabled():
+            self._append_grad_norm_probe(params_grads)
         params_grads = append_gradient_clip_ops(params_grads)
         params_grads = append_regularization_ops(params_grads, self.regularization)
         return self._create_optimization_pass(params_grads)
+
+    @staticmethod
+    def _append_grad_norm_probe(params_grads):
+        """PADDLE_TPU_GRAD_NORM=1: append ops writing the pre-clip global
+        gradient norm into ``monitor.GRAD_NORM_VAR``; the Executor fetches
+        it as a hidden extra and mirrors it into the
+        ``optimizer/grad_global_norm`` gauge after each step. Deliberately
+        NOT persistable: it is a per-step probe, not model state — keeping
+        it out of the persistable set keeps it out of
+        save/load_persistables checkpoints and out of the program-cache
+        state signature. XLA fuses the reduction into the step, so the only
+        added cost is the Executor's scalar fetch."""
+        from . import monitor as _monitor
+
+        grads = [g for p, g in params_grads
+                 if g is not None and not getattr(p, "is_sparse_param", False)]
+        if not grads:
+            return
+        block = grads[0].block
+        if block.has_var(_monitor.GRAD_NORM_VAR):
+            return  # one probe per program
+        helper = LayerHelper("grad_norm_probe")
+        sqs = []
+        for g in grads:
+            sq = helper.create_variable_for_type_inference(g.dtype)
+            block.append_op("squared_l2_norm", inputs={"X": g},
+                            outputs={"Out": sq})
+            sqs.append(sq)
+        gsum = helper.create_variable_for_type_inference("float32")
+        block.append_op("sum", inputs={"X": sqs}, outputs={"Out": gsum})
+        out = block.create_var(name=_monitor.GRAD_NORM_VAR, dtype="float32",
+                               persistable=False)
+        block.append_op("sqrt", inputs={"X": gsum}, outputs={"Out": out})
 
     def _create_optimization_pass(self, parameters_and_grads):
         """reference: optimizer.py:198."""

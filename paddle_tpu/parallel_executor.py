@@ -18,8 +18,12 @@ from .core.framework import default_main_program
 from .core.place import CPUPlace, TPUPlace
 from .core.scope import global_scope
 from .executor import Executor
+from .monitor import metrics as _mx, tracer as _tr
 
 __all__ = ["ParallelExecutor"]
+
+_m_runs = _mx.counter("parallel_executor/runs",
+                      help="ParallelExecutor.run invocations (legacy wrapper)")
 
 
 class ParallelExecutor:
@@ -48,6 +52,12 @@ class ParallelExecutor:
         alias feed wins over)."""
         if feed is None:
             feed = feed_dict
+        _m_runs.inc()
+        if _tr._active:
+            with _tr.span("parallel_executor/run", cat="executor"):
+                return self._exe.run(self._compiled, feed=feed,
+                                     fetch_list=fetch_list, scope=self._scope,
+                                     return_numpy=return_numpy)
         return self._exe.run(self._compiled, feed=feed, fetch_list=fetch_list,
                              scope=self._scope, return_numpy=return_numpy)
 

@@ -5,6 +5,14 @@ The TPU-native stack: ``jax.profiler`` captures both host events and device
 (TPU) timelines into a trace viewable in TensorBoard/Perfetto — the role the
 reference splits between RecordEvent, CUPTI DeviceTracer, profiler.proto and
 tools/timeline.py. The context-manager UX is kept identical.
+
+For always-on, TensorBoard-free observability see
+:mod:`paddle_tpu.monitor`: a metrics registry (counters/gauges/histograms
+pre-wired through the Executor and readers) and a host-span tracer whose
+Chrome-trace export loads directly in ``chrome://tracing``.
+``record_event`` below feeds BOTH layers — the jax.profiler device trace
+and the monitor host-span timeline — so one annotation shows up wherever
+you are looking.
 """
 
 from __future__ import annotations
@@ -57,8 +65,13 @@ npu_profiler = profiler
 @contextlib.contextmanager
 def record_event(name: str):
     """RAII scope marker (reference: platform/profiler.h:41 RecordEvent) —
-    shows up as a named range in the trace."""
-    with jax.profiler.TraceAnnotation(name):
+    shows up as a named range in the jax.profiler device trace AND, when
+    host tracing is active (``PADDLE_TPU_TRACE_FILE`` /
+    ``monitor.tracer.start_tracing()``), as a host span in the Chrome-trace
+    export."""
+    from .monitor import tracer as _tr
+
+    with _tr.span(name, cat="user", device=True):
         yield
 
 
@@ -97,12 +110,17 @@ class StepProfiler:
             raise ValueError("sorted_key must be one of %s, got %r"
                              % (sorted(keys), sorted_key))
         rows = sorted(self._records.items(), key=keys[sorted_key])
-        lines = ["%-24s %8s %12s %12s %12s %12s" % (
-            "Event", "Calls", "Total(ms)", "Min(ms)", "Max(ms)", "Ave(ms)")]
+        lines = ["%-24s %8s %12s %12s %12s %12s %12s %12s" % (
+            "Event", "Calls", "Total(ms)", "Min(ms)", "Max(ms)", "Ave(ms)",
+            "P50(ms)", "P95(ms)")]
+        from .monitor.metrics import sorted_percentile
+
         for name, ts in rows:
-            lines.append("%-24s %8d %12.3f %12.3f %12.3f %12.3f" % (
+            st = sorted(ts)
+            lines.append("%-24s %8d %12.3f %12.3f %12.3f %12.3f %12.3f %12.3f" % (
                 name, len(ts), sum(ts) * 1e3, min(ts) * 1e3, max(ts) * 1e3,
-                sum(ts) / len(ts) * 1e3))
+                sum(ts) / len(ts) * 1e3, sorted_percentile(st, 50) * 1e3,
+                sorted_percentile(st, 95) * 1e3))
         lines.append("(kernel-level drill-down: run under profiler()/"
                      "start_profiler and open the trace dir in TensorBoard)")
         return "\n".join(lines)
@@ -110,11 +128,21 @@ class StepProfiler:
 
 __all__ += ["StepProfiler"]
 
+# Module-level default profiler: scripts that just want step timings can use
+# ``default_step_profiler().step(...)`` without threading an instance around,
+# and reset_profiler() has real state to clear (reference semantics).
+_default_step_profiler = StepProfiler()
+
+
+def default_step_profiler() -> StepProfiler:
+    return _default_step_profiler
+
 
 def reset_profiler():
-    """Clear collected profile data (reference: profiler.py reset_profiler).
-    jax.profiler traces are per start/stop window, so there is no global
-    accumulator to clear; provided for API parity."""
+    """Clear collected profile data (reference: profiler.py reset_profiler):
+    resets the module-level default StepProfiler. jax.profiler device traces
+    are per start/stop window and need no clearing."""
+    _default_step_profiler.reset()
 
 
-__all__ += ["reset_profiler"]
+__all__ += ["reset_profiler", "default_step_profiler"]

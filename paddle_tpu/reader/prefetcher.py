@@ -11,12 +11,22 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Dict, Iterator, Optional
 
 import jax
 import numpy as np
 
+from ..monitor import metrics as _mx
+
 __all__ = ["DevicePrefetcher"]
+
+_m_depth = _mx.gauge("prefetcher/queue_depth",
+                     help="ready-on-device batches buffered ahead")
+_m_h2d_ms = _mx.histogram("prefetcher/h2d_ms",
+                          help="host→device put dispatch time per batch")
+_m_wait_ms = _mx.histogram("prefetcher/wait_time_ms",
+                           help="consumer wait for the next device batch")
 
 
 class DevicePrefetcher:
@@ -49,7 +59,13 @@ class DevicePrefetcher:
         try:
             tgt = self._target()
             for feed in self._src:
-                self._q.put({k: jax.device_put(v, tgt) for k, v in feed.items()})
+                if _mx.enabled():
+                    t0 = time.perf_counter()
+                    out = {k: jax.device_put(v, tgt) for k, v in feed.items()}
+                    _m_h2d_ms.observe((time.perf_counter() - t0) * 1e3)
+                else:
+                    out = {k: jax.device_put(v, tgt) for k, v in feed.items()}
+                self._q.put(out)
         except Exception as e:  # propagate into the consumer
             self._err = e
         finally:
@@ -59,7 +75,13 @@ class DevicePrefetcher:
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
         while True:
-            item = self._q.get()
+            if _mx.enabled():
+                _m_depth.set(self._q.qsize())
+                t0 = time.perf_counter()
+                item = self._q.get()
+                _m_wait_ms.observe((time.perf_counter() - t0) * 1e3)
+            else:
+                item = self._q.get()
             if item is self._END:
                 if self._err is not None:
                     raise self._err

@@ -15,11 +15,23 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from ..monitor import metrics as _mx
+
 __all__ = ["PyReader", "EOFException"]
+
+# Input-pipeline health: a queue depth pinned at 0 with a fat wait-time
+# histogram = the step loop is input-bound (the buffered_reader starvation
+# signal the reference surfaces only via timeline gaps).
+_m_queue_depth = _mx.gauge("reader/queue_depth",
+                           help="py_reader queue depth at next_feed")
+_m_wait_ms = _mx.histogram("reader/wait_time_ms",
+                           help="time Executor.run blocked waiting for a batch")
+_m_batches = _mx.counter("reader/batches", help="batches drained via next_feed")
 
 
 class EOFException(Exception):
@@ -134,7 +146,15 @@ class PyReader:
         """One batch as {var_name: array}; EOFException when exhausted."""
         if not self._started:
             raise RuntimeError("py_reader not started; call reader.start()")
-        item = self._q.get()
+        if _mx.enabled():
+            _m_queue_depth.set(self._q.qsize())
+            t0 = time.perf_counter()
+            item = self._q.get()
+            if item is not self._END:
+                _m_wait_ms.observe((time.perf_counter() - t0) * 1e3)
+                _m_batches.inc()
+        else:
+            item = self._q.get()
         if item is self._END:
             self._started = False
             if self._err is not None:

@@ -1,0 +1,478 @@
+"""paddle_tpu.monitor tests: registry semantics, span nesting + Chrome-trace
+schema, executor cache-hit/miss wiring, reader queue gauges, and the
+satellite fixes (vlog %-literal, profiler reset/percentiles, dump_metrics
+round-trip)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+from paddle_tpu.monitor import metrics as mx
+from paddle_tpu.monitor import tracer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    mx.enable()
+    mx.reset()
+    tracer.clear_spans()
+    yield
+    mx.enable()
+    mx.reset()
+    tracer.clear_spans()
+
+
+# -- registry -----------------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    c = mx.counter("t/counter")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+
+    g = mx.gauge("t/gauge")
+    g.set(10)
+    g.inc(5)
+    g.dec(1)
+    assert g.value == 14
+
+    h = mx.histogram("t/hist", buckets=[1, 10, 100])
+    for v in (0.5, 5, 50, 500):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 4
+    assert snap["sum"] == 555.5
+    assert snap["min"] == 0.5 and snap["max"] == 500
+    assert snap["buckets"] == {"le_1": 1, "le_10": 1, "le_100": 1, "le_inf": 1}
+    assert 0 < snap["p50"] <= 50
+    assert snap["p95"] <= 500
+
+
+def test_registry_get_or_create_and_kind_conflict():
+    assert mx.counter("t/same") is mx.counter("t/same")
+    with pytest.raises(TypeError):
+        mx.gauge("t/same")
+
+
+def test_histogram_bucket_conflict_raises():
+    h = mx.histogram("t/buckets", buckets=[1, 2, 4])
+    assert mx.histogram("t/buckets") is h  # no buckets = don't care
+    assert mx.histogram("t/buckets", buckets=[4, 2, 1]) is h  # order-insensitive
+    with pytest.raises(ValueError):
+        mx.histogram("t/buckets", buckets=[1, 2, 8])
+
+
+def test_tracer_span_cap(monkeypatch):
+    monkeypatch.setattr(tracer, "_max_spans", 3)
+    tracer.start_tracing()
+    for i in range(6):
+        tracer.instant("cap/%d" % i)
+    tracer.stop_tracing()
+    assert len(tracer.get_spans()) == 3
+    assert tracer._dropped == 3
+    tracer.clear_spans()
+    assert tracer._dropped == 0
+
+
+def test_disabled_is_inert_and_reset_keeps_handles():
+    c = mx.counter("t/toggle")
+    c.inc(2)
+    mx.disable()
+    c.inc(100)
+    mx.gauge("t/toggle_g").set(9)
+    mx.histogram("t/toggle_h").observe(1)
+    assert not mx.enabled()
+    mx.enable()
+    assert c.value == 2
+    assert mx.gauge("t/toggle_g").value == 0
+    assert mx.histogram("t/toggle_h").count == 0
+
+    mx.reset()
+    assert c.value == 0
+    c.inc(7)  # same handle still registered and live
+    assert mx.snapshot()["t/toggle"]["value"] == 7
+
+
+def test_snapshot_json_and_text_roundtrip():
+    mx.counter("t/js").inc(3)
+    mx.histogram("t/jh").observe(2.0)
+    doc = json.loads(mx.to_json())
+    assert doc["t/js"]["value"] == 3
+    assert doc["t/jh"]["count"] == 1
+    txt = mx.to_text()
+    assert "t/js" in txt and "t/jh" in txt
+
+
+def test_thread_safety_under_contention():
+    c = mx.counter("t/mt")
+    h = mx.histogram("t/mt_h", buckets=[10])
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+            h.observe(1)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+    assert h.count == 8000
+
+
+# -- tracer -------------------------------------------------------------------
+
+def test_span_nesting_and_chrome_schema():
+    tracer.start_tracing()
+    with tracer.span("outer", args={"k": "v"}):
+        with tracer.span("inner"):
+            pass
+        with tracer.span("inner2"):
+            pass
+    spans = tracer.stop_tracing()
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["inner"]["depth"] == by_name["outer"]["depth"] + 1
+    assert by_name["inner2"]["depth"] == by_name["outer"]["depth"] + 1
+    # children temporally contained in the parent
+    o = by_name["outer"]
+    for child in ("inner", "inner2"):
+        s = by_name[child]
+        assert s["ts_us"] >= o["ts_us"]
+        assert s["ts_us"] + s["dur_us"] <= o["ts_us"] + o["dur_us"]
+
+    doc = tracer.to_chrome_trace(spans)
+    assert "traceEvents" in doc and isinstance(doc["traceEvents"], list)
+    complete = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in complete} == {"outer", "inner", "inner2"}
+    for e in complete:
+        assert {"name", "cat", "ts", "dur", "pid", "tid"} <= set(e)
+    assert any(e["ph"] == "M" for e in doc["traceEvents"])  # metadata present
+    assert by_name["outer"]["args"] == {"k": "v"}
+
+
+def test_spans_raw_file_chrome_roundtrip(tmp_path):
+    tracer.start_tracing()
+    with tracer.span("rt/a"):
+        with tracer.span("rt/b"):
+            pass
+    spans = tracer.stop_tracing()
+    raw = tmp_path / "spans.json"
+    chrome = tmp_path / "trace.json"
+    tracer.save_spans(str(raw), spans)
+    assert tracer.load_spans(str(raw)) == spans
+    tracer.save_chrome_trace(str(chrome), spans)
+    back = tracer.load_spans(str(chrome))  # chrome -> spans round-trip
+    assert {s["name"] for s in back} == {"rt/a", "rt/b"}
+    assert sorted(s["dur_us"] for s in back) == sorted(s["dur_us"] for s in spans)
+
+
+def test_inactive_tracer_records_nothing():
+    assert not tracer.active()
+    with tracer.span("ghost"):
+        pass
+    assert tracer.get_spans() == []
+
+
+def test_trace_file_env_autostart(tmp_path):
+    """PADDLE_TPU_TRACE_FILE=... writes a loadable Chrome trace at exit.
+    The tracer module is stdlib-only, so the subprocess loads it standalone
+    (no jax import) and stays fast."""
+    out = tmp_path / "trace.json"
+    code = (
+        "import importlib.util\n"
+        "spec = importlib.util.spec_from_file_location('t', %r)\n"
+        "m = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(m)\n"
+        "assert m.active()\n"
+        "with m.span('auto/outer'):\n"
+        "    with m.span('auto/inner'):\n"
+        "        pass\n"
+    ) % os.path.join(REPO, "paddle_tpu", "monitor", "tracer.py")
+    env = dict(os.environ, PADDLE_TPU_TRACE_FILE=str(out))
+    subprocess.run([sys.executable, "-c", code], check=True, env=env,
+                   timeout=60)
+    doc = json.loads(out.read_text())
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert names == {"auto/outer", "auto/inner"}
+
+
+# -- executor wiring ----------------------------------------------------------
+
+def _mlp_program(dim=6, classes=3):
+    x = fluid.layers.data("x", shape=[dim])
+    y = fluid.layers.data("y", shape=[1], dtype="int64")
+    logits = fluid.layers.fc(x, size=classes)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def test_executor_cache_hit_miss_counters(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        loss = _mlp_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    mx.reset()
+    feed8 = {"x": rng.randn(8, 6).astype("float32"),
+             "y": rng.randint(0, 3, (8, 1)).astype("int64")}
+    exe.run(main, feed=feed8, fetch_list=[loss])
+    snap = mx.snapshot()
+    assert snap["executor/cache_miss"]["value"] == 1
+    assert snap["executor/cache_hit"]["value"] == 0
+    assert snap["executor/compile_time_ms"]["count"] == 1
+
+    # same feed signature -> hit, and a steady-state step-time observation
+    exe.run(main, feed=feed8, fetch_list=[loss])
+    snap = mx.snapshot()
+    assert snap["executor/cache_hit"]["value"] == 1
+    assert snap["executor/cache_miss"]["value"] == 1
+    assert snap["executor/step_time_ms"]["count"] == 1
+    assert snap["executor/step_time_ms"]["sum"] > 0
+
+    # different batch shape -> new specialization -> miss
+    feed16 = {"x": rng.randn(16, 6).astype("float32"),
+              "y": rng.randint(0, 3, (16, 1)).astype("int64")}
+    exe.run(main, feed=feed16, fetch_list=[loss])
+    snap = mx.snapshot()
+    assert snap["executor/cache_miss"]["value"] == 2
+    assert snap["executor/cache_hit"]["value"] == 1
+
+    assert snap["executor/runs"]["value"] == 3
+    # per row: 6 f32 features + 1 label canonicalized to int32 = 28 bytes
+    assert snap["executor/feed_bytes"]["value"] == (8 + 8 + 16) * (6 * 4 + 4)
+    assert snap["executor/fetch_bytes"]["value"] == 3 * 4  # three f32 scalars
+
+
+def test_executor_disabled_metrics_stay_zero(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        loss = _mlp_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    mx.reset()
+    mx.disable()
+    feed = {"x": rng.randn(4, 6).astype("float32"),
+            "y": rng.randint(0, 3, (4, 1)).astype("int64")}
+    out, = exe.run(main, feed=feed, fetch_list=[loss])
+    mx.enable()
+    snap = mx.snapshot()
+    assert np.isfinite(out).all()  # run itself unaffected
+    assert snap["executor/runs"]["value"] == 0
+    assert snap["executor/cache_miss"]["value"] == 0
+    assert snap["executor/step_time_ms"]["count"] == 0
+
+
+def test_executor_step_spans_when_tracing(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        loss = _mlp_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {"x": rng.randn(4, 6).astype("float32"),
+            "y": rng.randint(0, 3, (4, 1)).astype("int64")}
+    tracer.start_tracing()
+    exe.run(main, feed=feed, fetch_list=[loss])
+    exe.run(main, feed=feed, fetch_list=[loss])
+    spans = tracer.stop_tracing()
+    names = [s["name"] for s in spans]
+    assert "executor/trace_setup" in names
+    assert "executor/compile_and_step" in names
+    assert "executor/step" in names
+
+
+def test_grad_norm_gauge_opt_in(rng, monkeypatch, tmp_path):
+    monkeypatch.setenv("PADDLE_TPU_GRAD_NORM", "1")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        loss = _mlp_program()
+    probe = main.global_block.var(monitor.GRAD_NORM_VAR)
+    assert not probe.persistable  # a per-step probe, never model state
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    mx.reset()
+    feed = {"x": rng.randn(8, 6).astype("float32"),
+            "y": rng.randint(0, 3, (8, 1)).astype("int64")}
+    out, = exe.run(main, feed=feed, fetch_list=[loss])
+    assert out.size == 1  # the hidden extra fetch never reaches the caller
+    assert mx.snapshot()["optimizer/grad_global_norm"]["value"] > 0
+    # the probe must not break program caching
+    exe.run(main, feed=feed, fetch_list=[loss])
+    assert mx.snapshot()["executor/cache_hit"]["value"] == 1
+    # ...nor checkpointing: the probe var stays out of save_persistables
+    fluid.io.save_persistables(exe, str(tmp_path / "ckpt"), main)
+    fluid.io.load_persistables(exe, str(tmp_path / "ckpt"), main)
+    exe.run(main, feed=feed, fetch_list=[loss])
+
+
+# -- reader wiring ------------------------------------------------------------
+
+def test_py_reader_queue_depth_and_wait(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = fluid.layers.py_reader(
+            capacity=8, shapes=[[-1, 4], [-1, 1]],
+            dtypes=["float32", "int64"], name="mon_reader")
+        img, label = fluid.layers.read_file(reader)
+        logits = fluid.layers.fc(img, size=2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    batches = [(rng.randn(4, 4).astype("float32"),
+                rng.randint(0, 2, (4, 1)).astype("int64")) for _ in range(5)]
+    reader.decorate_tensor_provider(lambda: iter(batches))
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    mx.reset()
+    reader.start()
+    n = 0
+    with pytest.raises(fluid.EOFException):
+        while True:
+            exe.run(main, fetch_list=[loss])
+            n += 1
+    reader.reset()
+    assert n == 5
+    snap = mx.snapshot()
+    assert snap["reader/batches"]["value"] == 5
+    assert snap["reader/wait_time_ms"]["count"] == 5
+    assert snap["reader/queue_depth"]["set"] is True
+
+
+def test_device_prefetcher_gauges(rng):
+    from paddle_tpu.reader.prefetcher import DevicePrefetcher
+
+    feeds = [{"a": rng.randn(2, 3).astype("float32")} for _ in range(4)]
+    mx.reset()
+    got = list(DevicePrefetcher(iter(feeds), capacity=2))
+    assert len(got) == 4
+    snap = mx.snapshot()
+    assert snap["prefetcher/h2d_ms"]["count"] == 4
+    assert snap["prefetcher/wait_time_ms"]["count"] == 5  # 4 batches + END
+    assert snap["prefetcher/queue_depth"]["set"] is True
+
+
+# -- step logger --------------------------------------------------------------
+
+def test_step_logger_summary_and_lines(caplog):
+    import logging
+
+    slog = monitor.StepLogger(every_n=2, name="t")
+    with caplog.at_level(logging.INFO, logger="paddle_tpu.monitor"):
+        for i in range(6):
+            slog.step(loss=float(10 - i), examples=32)
+    assert len([r for r in caplog.records if "[t] step" in r.message]) == 3
+    s = slog.summary()
+    assert s["steps"] == 6
+    assert s["examples"] == 6 * 32
+    assert s["last_loss"] == 5.0
+    assert "p50" in s["step_time_ms"] and "p95" in s["step_time_ms"]
+
+
+def test_step_logger_reset_clears_pending_loss():
+    slog = monitor.StepLogger(every_n=100, name="t2")
+    slog.step(loss=5.0, examples=1)
+    slog.reset()
+    slog.step(examples=1)  # no loss observed since reset
+    assert "last_loss" not in slog.summary()
+
+
+def test_instant_events_survive_chrome_roundtrip(tmp_path):
+    tracer.start_tracing()
+    with tracer.span("ri/span"):
+        tracer.instant("ri/marker", args={"n": 1})
+    spans = tracer.stop_tracing()
+    chrome = tmp_path / "trace.json"
+    tracer.save_chrome_trace(str(chrome), spans)
+    back = tracer.load_spans(str(chrome))
+    assert {s["name"] for s in back} == {"ri/span", "ri/marker"}
+    marker = next(s for s in back if s["name"] == "ri/marker")
+    assert marker["dur_us"] == 0 and marker["args"] == {"n": 1}
+
+
+# -- satellites ---------------------------------------------------------------
+
+def test_vlog_literal_percent_and_cached_level(caplog, monkeypatch):
+    import logging
+
+    from paddle_tpu import log as plog
+
+    plog.set_vlog_level(2)
+    try:
+        with caplog.at_level(logging.INFO, logger="paddle_tpu"):
+            plog.vlog(1, "reached 100% of quota")  # raised ValueError before
+            plog.vlog(1, "step %d of %d", 3, 7)
+            plog.vlog(3, "above level — suppressed")
+        assert plog.vlog_level() == 2
+        # cached: changing the env alone must NOT alter the parsed level
+        monkeypatch.setenv("GLOG_v", "9")
+        assert plog.vlog_level() == 2
+    finally:
+        plog.set_vlog_level(None)
+    msgs = [r.message for r in caplog.records]
+    assert "[VLOG1] reached 100% of quota" in msgs
+    assert "[VLOG1] step 3 of 7" in msgs
+    assert not any("suppressed" in m for m in msgs)
+
+
+def test_reset_profiler_clears_default_step_profiler():
+    prof = fluid.profiler.default_step_profiler()
+    with prof.step("warm"):
+        pass
+    assert "warm" in prof.summary()
+    fluid.profiler.reset_profiler()
+    assert "warm" not in fluid.profiler.default_step_profiler().summary()
+
+
+def test_step_profiler_percentile_columns():
+    prof = fluid.profiler.StepProfiler()
+    for _ in range(10):
+        with prof.step("s"):
+            pass
+    table = prof.summary()
+    assert "P50(ms)" in table and "P95(ms)" in table
+
+
+def test_dump_metrics_cli_roundtrip(tmp_path):
+    from tools import dump_metrics
+
+    tracer.start_tracing()
+    with tracer.span("cli/a"):
+        pass
+    spans = tracer.stop_tracing()
+    raw = tmp_path / "spans.json"
+    chrome = tmp_path / "trace.json"
+    tracer.save_spans(str(raw), spans)
+    assert dump_metrics.main(["--to-chrome", str(raw), str(chrome)]) == 0
+    doc = json.loads(chrome.read_text())
+    dump_metrics.validate_chrome_trace(doc)
+    # idempotent: a Chrome trace converts to itself
+    chrome2 = tmp_path / "trace2.json"
+    assert dump_metrics.main(["--to-chrome", str(chrome), str(chrome2)]) == 0
+    assert ({e["name"] for e in json.loads(chrome2.read_text())["traceEvents"]
+             if e["ph"] == "X"}
+            == {s["name"] for s in spans})
+
+    snap_file = tmp_path / "snap.json"
+    mx.counter("cli/c").inc(4)
+    snap_file.write_text(mx.to_json())
+    assert dump_metrics.main([str(snap_file)]) == 0
+
+
+def test_dump_metrics_selftest():
+    from tools import dump_metrics
+
+    assert dump_metrics.selftest() == 0

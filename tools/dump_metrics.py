@@ -1,0 +1,155 @@
+"""Inspect paddle_tpu.monitor artifacts from the command line.
+
+The tools/timeline.py of this stack, plus a metrics pretty-printer:
+
+    python -m tools.dump_metrics snapshot.json
+        Pretty-print a metrics snapshot (the ``monitor.to_json()`` /
+        bench-JSON ``metrics`` format) as an aligned table.
+
+    python -m tools.dump_metrics --to-chrome spans.json trace.json
+        Convert a raw host-span file (``monitor.tracer.save_spans``) to a
+        chrome://tracing / Perfetto-loadable Chrome trace. Accepts an
+        existing Chrome trace too (idempotent), so the conversion
+        round-trips.
+
+    python -m tools.dump_metrics --selftest
+        Exercise registry + tracer + the Chrome-trace round-trip
+        in-process and exit 0/1. Needs no TPU (run under
+        ``JAX_PLATFORMS=cpu``); the CI smoke check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from paddle_tpu.monitor import metrics, tracer  # noqa: E402
+
+
+def format_snapshot(snap: dict) -> str:
+    """Aligned table for a ``monitor.snapshot()``-format dict."""
+    lines = ["%-40s %-9s %s" % ("metric", "type", "value"),
+             "-" * 72]
+    for name in sorted(snap):
+        s = snap[name]
+        t = s.get("type", "?")
+        if t == "histogram":
+            detail = ("count=%d mean=%.3f p50=%.3f p95=%.3f min=%.3f max=%.3f"
+                      % (s.get("count", 0), s.get("mean", 0.0),
+                         s.get("p50", 0.0), s.get("p95", 0.0),
+                         s.get("min", 0.0), s.get("max", 0.0)))
+        else:
+            v = s.get("value", 0)
+            detail = ("%d" % v) if float(v).is_integer() else ("%.6g" % v)
+        lines.append("%-40s %-9s %s" % (name, t, detail))
+    return "\n".join(lines)
+
+
+def dump_snapshot(path: str) -> int:
+    with open(path) as f:
+        doc = json.load(f)
+    # accept a whole bench JSON ({"detail": ..., "metrics": {...}}) too
+    if "metrics" in doc and all(
+            not isinstance(v, dict) or "type" not in v for v in doc.values()):
+        doc = doc["metrics"]
+    print(format_snapshot(doc))
+    return 0
+
+
+def to_chrome(src: str, dst: str) -> int:
+    spans = tracer.load_spans(src)
+    tracer.save_chrome_trace(dst, spans)
+    print("wrote %d span(s) -> %s" % (len(spans), dst))
+    return 0
+
+
+def validate_chrome_trace(doc: dict) -> None:
+    """Raise AssertionError unless ``doc`` is a loadable Chrome trace."""
+    assert isinstance(doc, dict) and "traceEvents" in doc, "missing traceEvents"
+    assert isinstance(doc["traceEvents"], list), "traceEvents must be a list"
+    for ev in doc["traceEvents"]:
+        assert "ph" in ev and "pid" in ev, "event missing ph/pid: %r" % (ev,)
+        if ev["ph"] == "X":
+            assert {"name", "ts", "dur", "tid"} <= set(ev), \
+                "complete event missing fields: %r" % (ev,)
+
+
+def selftest() -> int:
+    # 1. registry: counter/gauge/histogram + snapshot/reset
+    metrics.enable()
+    c = metrics.counter("selftest/count")
+    c.inc(3)
+    metrics.gauge("selftest/gauge").set(1.5)
+    h = metrics.histogram("selftest/hist")
+    for v in (0.2, 2.0, 40.0):
+        h.observe(v)
+    snap = metrics.snapshot()
+    assert snap["selftest/count"]["value"] == 3
+    assert snap["selftest/hist"]["count"] == 3
+    assert "p95" in snap["selftest/hist"]
+    format_snapshot(snap)  # must not raise
+    # disabled = inert
+    metrics.disable()
+    c.inc(100)
+    metrics.enable()
+    assert c.value == 3
+    # 2. tracer: nested spans -> raw file -> CLI conversion -> valid Chrome
+    tracer.start_tracing()
+    with tracer.span("selftest/outer"):
+        with tracer.span("selftest/inner", args={"k": 1}):
+            pass
+    spans = tracer.stop_tracing()
+    mine = [s for s in spans if s["name"].startswith("selftest/")]
+    assert {s["name"] for s in mine} == {"selftest/outer", "selftest/inner"}
+    inner = next(s for s in mine if s["name"] == "selftest/inner")
+    outer = next(s for s in mine if s["name"] == "selftest/outer")
+    assert inner["depth"] == outer["depth"] + 1, "span nesting lost"
+    with tempfile.TemporaryDirectory() as td:
+        raw = os.path.join(td, "spans.json")
+        chrome = os.path.join(td, "trace.json")
+        tracer.save_spans(raw, mine)
+        to_chrome(raw, chrome)
+        with open(chrome) as f:
+            doc = json.load(f)
+        validate_chrome_trace(doc)
+        # round-trip: chrome trace back to spans, names/durations preserved
+        back = tracer.load_spans(chrome)
+        assert {s["name"] for s in back} == {s["name"] for s in mine}
+        assert sorted(s["dur_us"] for s in back) == sorted(
+            s["dur_us"] for s in mine)
+    metrics.reset()
+    print("dump_metrics selftest: OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 0 if argv else 2
+    if argv[0] == "--selftest":
+        return selftest()
+    if argv[0] == "--to-chrome":
+        if len(argv) != 3:
+            print("usage: dump_metrics --to-chrome spans.json trace.json",
+                  file=sys.stderr)
+            return 2
+        return to_chrome(argv[1], argv[2])
+    if len(argv) != 1:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    return dump_snapshot(argv[0])
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # e.g. piped into head
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

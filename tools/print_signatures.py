@@ -28,6 +28,9 @@ MODULES = [
     "paddle_tpu.clip",
     "paddle_tpu.io",
     "paddle_tpu.metrics",
+    "paddle_tpu.monitor",
+    "paddle_tpu.monitor.metrics",
+    "paddle_tpu.monitor.tracer",
     "paddle_tpu.nets",
     "paddle_tpu.reader",
     "paddle_tpu.backward",

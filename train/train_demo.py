@@ -1,0 +1,64 @@
+"""Python twin of demo_trainer.cc, with monitor wiring: load the programs
+saved by ``save_program.py`` and train them with a ``monitor.StepLogger``
+emitting periodic throughput/step-time/loss lines, then dump the metrics
+snapshot (cache hits, step-time histogram) at the end.
+
+    python train/save_program.py /tmp/demo_program
+    python train/train_demo.py /tmp/demo_program [steps]
+
+Runs on CPU (``JAX_PLATFORMS=cpu``) or TPU alike; set
+``PADDLE_TPU_TRACE_FILE=/tmp/trace.json`` to also get a Chrome trace of
+the host timeline.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import monitor  # noqa: E402
+from paddle_tpu.core import serialization  # noqa: E402
+
+
+def main(prog_dir, steps=200, batch=64, log_every=20):
+    with open(os.path.join(prog_dir, "startup.json")) as f:
+        startup = serialization.loads(f.read())
+    with open(os.path.join(prog_dir, "main.json")) as f:
+        main_p = serialization.loads(f.read())
+    with open(os.path.join(prog_dir, "meta.txt")) as f:
+        _repo, loss_name, dims = f.read().splitlines()[:3]
+    dim, classes = (int(t) for t in dims.split())
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    # separable synthetic data so the loss visibly falls (demo_trainer.cc's
+    # convergence check)
+    rng = np.random.RandomState(0)
+    centers = rng.randn(classes, dim).astype("float32") * 2.0
+
+    slog = monitor.StepLogger(every_n=log_every, name="train_demo")
+    last = None
+    for _ in range(int(steps)):
+        y = rng.randint(0, classes, (batch, 1)).astype("int64")
+        x = (centers[y[:, 0]] + rng.randn(batch, dim).astype("float32") * 0.5)
+        last, = exe.run(main_p, feed={"x": x, "y": y},
+                        fetch_list=[loss_name])
+        slog.step(loss=last, examples=batch)
+
+    summary = slog.summary()
+    print("final loss %.4f after %d steps" % (float(last), summary["steps"]))
+    print(monitor.to_text())
+    if float(last) > 1.0:
+        print("WARNING: loss did not converge", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "demo_program",
+                  *(int(a) for a in sys.argv[2:3])))
