@@ -160,6 +160,59 @@ def _timeit(run_step, batch, skip=5, iters=20, epochs=3):
     return batch * iters / dt, iters / dt
 
 
+def _timeit_pipeline(exe, prog, feed, fetch_list, batch, skip=5, iters=20,
+                     epochs=3, fetch_every=8):
+    """Async-driver twin of _timeit: each epoch is ``iters`` steps driven by
+    ``Executor.run_steps`` with ``fetch_every`` steps fused per dispatch
+    (1/``fetch_every`` the host dispatches of the run()-per-step loop).
+
+    Two numbers per epoch land in the bench JSON: ``host_dispatch_ms_per_
+    step`` — wall time until every chunk is dispatched, fetches unresolved
+    (the pipeline-headroom signal: how far the host runs ahead of the
+    device) — and ``synced_step_ms`` — dispatch + resolving the final
+    handle, which transitively waits for the whole chain (the truthful
+    throughput number; eps_* derive from it)."""
+
+    def rep(n):
+        return (feed for _ in range(n))
+
+    # warm with the full epoch step count so BOTH chain lengths (the
+    # fetch_every-chunk and the final partial chunk) compile outside the
+    # timed region
+    warm = max(iters, skip)
+    hs = exe.run_steps(prog, rep(warm), steps=warm, fetch_list=fetch_list,
+                       fetch_every=fetch_every, return_numpy=False)
+    np.asarray(hs[-1][0])
+    times, dispatch_times, n_dispatches = [], [], 0
+    for _ in range(max(1, epochs)):
+        t0 = time.time()
+        hs = exe.run_steps(prog, rep(iters), steps=iters,
+                           fetch_list=fetch_list, fetch_every=fetch_every,
+                           return_numpy=False)
+        dispatch_times.append(time.time() - t0)
+        out = np.asarray(hs[-1][0])  # sync: resolves the whole chain
+        assert np.isfinite(out).all()
+        times.append(time.time() - t0)
+        n_dispatches = len(hs)
+    dt = sorted(times)[len(times) // 2]
+    _timeit.last = {
+        "epoch_sec": [round(t, 4) for t in times],
+        "eps_median": batch * iters / dt,
+        "eps_max": batch * iters / min(times),
+        "eps_min": batch * iters / max(times),
+        "pipeline": {
+            "fetch_every": fetch_every,
+            "dispatches_per_epoch": n_dispatches,
+            "steps_per_dispatch": round(iters / max(n_dispatches, 1), 2),
+            "host_dispatch_ms_per_step": round(
+                sorted(dispatch_times)[len(dispatch_times) // 2]
+                / iters * 1e3, 4),
+            "synced_step_ms": round(dt / iters * 1e3, 4),
+        },
+    }
+    return batch * iters / dt, iters / dt
+
+
 def _last_spread():
     """Per-epoch spread of the most recent _timeit call, for bench JSON."""
     last = getattr(_timeit, "last", None)
@@ -173,6 +226,8 @@ def _last_spread():
         # honest name: chained async steps make these host dispatch gaps
         # (see _timeit docstring), not device step time
         out["host_dispatch_ms"] = sl["step_time_ms"]
+    if "pipeline" in last:
+        out["pipeline"] = last["pipeline"]
     return out
 
 
@@ -181,10 +236,11 @@ def _last_spread():
 
 def bench_transformer(batch=64, seq=256, vocab=30000, use_amp=True,
                       n_devices=None, skip=5, iters=20, model_devices=1,
-                      epochs=3):
+                      epochs=3, pipeline=False, fetch_every=8):
     """``n_devices``: run through CompiledProgram.with_mesh({'data': n}) —
     the GSPMD data-parallel path — with ``batch`` as the GLOBAL batch.
-    ``model_devices``: add a TP axis (dp x tp mesh, see _mesh_prog)."""
+    ``model_devices``: add a TP axis (dp x tp mesh, see _mesh_prog).
+    ``pipeline``: drive with the fused async Executor.run_steps driver."""
     import paddle_tpu as fluid
     from paddle_tpu.models import transformer as tfm
 
@@ -221,6 +277,11 @@ def bench_transformer(batch=64, seq=256, vocab=30000, use_amp=True,
             }
             feed = _device_feed(feed, mesh)
 
+            if pipeline:
+                return _timeit_pipeline(exe, prog, feed, [loss], batch,
+                                        skip=skip, iters=iters, epochs=epochs,
+                                        fetch_every=fetch_every)
+
             def step():
                 lv, = exe.run(prog, feed=feed, fetch_list=[loss],
                               return_numpy=False)
@@ -231,7 +292,8 @@ def bench_transformer(batch=64, seq=256, vocab=30000, use_amp=True,
 
 
 def bench_resnet50(batch=64, image=224, classes=1000, use_amp=True,
-                   n_devices=None, skip=5, iters=20, epochs=3):
+                   n_devices=None, skip=5, iters=20, epochs=3,
+                   pipeline=False, fetch_every=8):
     import paddle_tpu as fluid
     from paddle_tpu.models import resnet as rn
 
@@ -258,6 +320,11 @@ def bench_resnet50(batch=64, image=224, classes=1000, use_amp=True,
                 "label": rng.randint(0, classes, (batch, 1)).astype("int64"),
             }
             feed = _device_feed(feed, mesh)
+
+            if pipeline:
+                return _timeit_pipeline(exe, prog, feed, [loss], batch,
+                                        skip=skip, iters=iters, epochs=epochs,
+                                        fetch_every=fetch_every)
 
             def step():
                 lv, = exe.run(prog, feed=feed, fetch_list=[loss],
@@ -544,7 +611,8 @@ def _bert_train_flops_per_example(seq, n_mask, vocab=30522, n_layer=12,
     return 3 * (enc + heads)
 
 
-def bench_bert(batch=32, seq=128, n_mask=20, use_amp=True, skip=5, iters=20):
+def bench_bert(batch=32, seq=128, n_mask=20, use_amp=True, skip=5, iters=20,
+               epochs=3, pipeline=False, fetch_every=8):
     """BERT-base pretraining step (MLM+NSP) — the 4th north-star config
     (BASELINE.json; ref inference/tests/api/analyzer_bert_tester.cc names the
     model, its train config lives in models/bert.py here). Exercises
@@ -586,6 +654,11 @@ def bench_bert(batch=32, seq=128, n_mask=20, use_amp=True, skip=5, iters=20):
                 "mlbl": rng.randint(0, 30522, (batch * n_mask, 1)).astype("int64"),
                 "nsp": rng.randint(0, 2, (batch, 1)).astype("int64"),
             })
+
+            if pipeline:
+                return _timeit_pipeline(exe, main_prog, feed, [loss], batch,
+                                        skip=skip, iters=iters, epochs=epochs,
+                                        fetch_every=fetch_every)
 
             def step():
                 lv, = exe.run(main_prog, feed=feed, fetch_list=[loss],
@@ -1125,6 +1198,13 @@ def bench_scaling(axes_str="data=8"):
 
 
 def main():
+    # --pipeline: drive the transformer/ResNet/BERT benches with the fused
+    # async run_steps driver (fetch_every=8) instead of run()-per-step; the
+    # JSON detail gains a "pipeline" block (host dispatch gap vs synced step
+    # time) and the metrics section the executor/run_steps_* instruments.
+    pipeline = "--pipeline" in sys.argv
+    if pipeline:
+        sys.argv.remove("--pipeline")
     if len(sys.argv) > 1 and sys.argv[1] == "--mesh":
         if len(sys.argv) < 3:
             print(json.dumps({"error": "usage: bench.py --mesh data=8"}))
@@ -1141,7 +1221,7 @@ def main():
         return
 
     peak, kind = _device_peak_flops()
-    detail = {"device": kind}
+    detail = {"device": kind, "pipeline_mode": pipeline}
 
     batch, seq, vocab = 64, 256, 30000
     # the axon compile tunnel occasionally drops a connection mid-compile;
@@ -1149,7 +1229,8 @@ def main():
     # metric — but ONLY for connection-type failures, so a real numeric or
     # compile regression still fails loudly instead of being healed
     try:
-        tfm_eps, tfm_sps = bench_transformer(batch, seq, vocab, use_amp=True)
+        tfm_eps, tfm_sps = bench_transformer(batch, seq, vocab, use_amp=True,
+                                             pipeline=pipeline)
     except Exception as first_err:
         msg = repr(first_err)
         if not any(s in msg for s in ("response body closed", "remote_compile",
@@ -1158,7 +1239,8 @@ def main():
         sys.stderr.write("transformer bench hit a tunnel flake (%r); "
                          "retrying once\n" % (first_err,))
         time.sleep(20)
-        tfm_eps, tfm_sps = bench_transformer(batch, seq, vocab, use_amp=True)
+        tfm_eps, tfm_sps = bench_transformer(batch, seq, vocab, use_amp=True,
+                                             pipeline=pipeline)
     detail["transformer_bf16"] = {
         "examples_per_sec": round(tfm_eps, 2), "steps_per_sec": round(tfm_sps, 3),
         **_last_spread()}
@@ -1175,7 +1257,7 @@ def main():
         detail["raw_jax_transformer_bf16"] = {"error": repr(e)[:200]}
 
     try:
-        rn_eps, rn_sps = bench_resnet50()
+        rn_eps, rn_sps = bench_resnet50(pipeline=pipeline)
         detail["resnet50_bf16"] = {
             "examples_per_sec": round(rn_eps, 2), "steps_per_sec": round(rn_sps, 3),
             **_last_spread()}
@@ -1193,7 +1275,7 @@ def main():
 
     try:
         bb, bs, bm = 32, 128, 20
-        bert_eps, bert_sps = bench_bert(bb, bs, bm)
+        bert_eps, bert_sps = bench_bert(bb, bs, bm, pipeline=pipeline)
         detail["bert_base_bf16"] = {
             "examples_per_sec": round(bert_eps, 2),
             "steps_per_sec": round(bert_sps, 3), "batch": bb, "seq": bs,

@@ -5,7 +5,14 @@ twin at identical shapes, compiles, and prints XLA cost analysis (flops,
 bytes accessed) plus a measured per-step time for each. The delta in flops
 or bytes names the part of the traced program that raw JAX doesn't have.
 
-Usage: python benchmarks/diag_overhead.py  (on axon TPU)
+Usage: python benchmarks/diag_overhead.py          (on axon TPU)
+       python benchmarks/diag_overhead.py --host   (any backend, incl. CPU)
+
+``--host`` measures pure HOST dispatch overhead on a tiny MLP where device
+compute is negligible: per-step wall time of the cache-hit ``run()`` path
+(the dispatch-plan cache's hot path) and of the fused
+``run_steps(fetch_every=8)`` driver, plus dispatches-per-step from the
+monitor counters — the number the async-pipeline work optimizes.
 """
 
 from __future__ import annotations
@@ -19,6 +26,72 @@ import numpy as np
 def fmt(ca):
     return {k: ca.get(k) for k in ("flops", "bytes accessed", "transcendentals")
             if k in ca}
+
+
+def host_mode(steps=300, fetch_every=8):
+    """CPU-friendly per-step host dispatch cost: cache-hit run() vs the
+    fused run_steps driver. Prints one machine-greppable line per driver."""
+    sys.path.insert(0, ".")
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor
+
+    with fluid.unique_name.guard():
+        with fluid.scope_guard(fluid.Scope()):
+            main_prog, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main_prog, startup):
+                x = fluid.layers.data("x", shape=[64])
+                y = fluid.layers.data("y", shape=[1], dtype="int64")
+                h = fluid.layers.fc(x, size=64, act="relu")
+                logits = fluid.layers.fc(h, size=10)
+                loss = fluid.layers.mean(
+                    fluid.layers.softmax_with_cross_entropy(logits, y))
+                fluid.optimizer.Adam(1e-3).minimize(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            feed = {"x": jax.device_put(rng.randn(32, 64).astype("float32")),
+                    "y": jax.device_put(
+                        rng.randint(0, 10, (32, 1)).astype("int64"))}
+
+            # steps divisible by fetch_every: no partial-chunk compile
+            # inside a timed region
+            steps = (steps // fetch_every) * fetch_every
+
+            for _ in range(10):  # compile + warm the dispatch plan
+                exe.run(main_prog, feed=feed, fetch_list=[loss],
+                        return_numpy=False)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = exe.run(main_prog, feed=feed, fetch_list=[loss],
+                              return_numpy=False)
+            run_ms = (time.perf_counter() - t0) / steps * 1e3
+            np.asarray(out[0])
+            print("host_dispatch_ms run()      : %.4f  (cache-hit, "
+                  "return_numpy=False)" % run_ms)
+
+            def rep(n):
+                return (feed for _ in range(n))
+
+            exe.run_steps(main_prog, rep(2 * fetch_every),
+                          steps=2 * fetch_every, fetch_list=[loss],
+                          fetch_every=fetch_every, return_numpy=False)
+            monitor.metrics.reset()
+            t0 = time.perf_counter()
+            hs = exe.run_steps(main_prog, rep(steps), steps=steps,
+                               fetch_list=[loss], fetch_every=fetch_every,
+                               return_numpy=False)
+            rs_ms = (time.perf_counter() - t0) / steps * 1e3
+            hs[-1].block()
+            snap = monitor.snapshot()
+            n_disp = snap["executor/run_steps_dispatches"]["value"]
+            n_steps = snap["executor/run_steps_steps"]["value"]
+            print("host_dispatch_ms run_steps(): %.4f  (fetch_every=%d, "
+                  "dispatches/step=%.3f)"
+                  % (rs_ms, fetch_every, n_disp / max(n_steps, 1)))
+            print("dispatch_reduction          : %.1fx fewer dispatched "
+                  "calls" % (n_steps / max(n_disp, 1)))
 
 
 def main():
@@ -100,4 +173,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--host" in sys.argv:
+        host_mode()
+    else:
+        main()

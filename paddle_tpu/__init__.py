@@ -17,6 +17,12 @@ Typical use mirrors Fluid:
     loss_val, = exe.run(feed={"x": xb, "y": yb}, fetch_list=[loss])
 """
 
+# Wire the persistent XLA compile cache BEFORE anything can trigger a
+# compile — PADDLE_TPU_COMPILE_CACHE=<dir> makes restarts skip re-compiles.
+from . import compile_cache as _compile_cache  # noqa: F401
+
+_compile_cache.setup_compile_cache()
+
 from . import (  # noqa: F401
     amp,
     backward,
@@ -63,7 +69,7 @@ from .core.pass_framework import (  # noqa: F401
 )
 from .core.place import CPUPlace, CUDAPinnedPlace, TPUPlace, is_compiled_with_tpu  # noqa: F401
 from .core.scope import Scope, global_scope, scope_guard  # noqa: F401
-from .executor import Executor  # noqa: F401
+from .executor import Executor, FetchHandle  # noqa: F401
 from .layers.layer_helper import ParamAttr, WeightNormParamAttr  # noqa: F401
 
 # Fluid compatibility: CUDAPlace maps to the accelerator (TPU) place.

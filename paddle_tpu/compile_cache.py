@@ -1,0 +1,96 @@
+"""Persistent XLA compile cache (``PADDLE_TPU_COMPILE_CACHE=<dir>``).
+
+The TVM argument (PAPERS.md) applied to this stack: the traced step is an
+ahead-of-time compilation artifact, yet by default every process restart
+re-pays the full XLA compile — minutes for the big train steps. JAX ships a
+persistent on-disk compilation cache; this module wires it up at import
+when ``PADDLE_TPU_COMPILE_CACHE`` names a directory, with the cache
+thresholds zeroed so *every* executable is cached (JAX's defaults skip
+fast-compiling programs, which would make CPU tests and small models look
+like the cache doesn't work).
+
+Observability: a ``compile_cache/hit`` / ``compile_cache/miss`` counter
+pair in :mod:`paddle_tpu.monitor`, fed by JAX's own monitoring events — so
+a bench JSON ``metrics`` section from a warm process shows the hits
+directly. Pair with ``tools/warmup.py`` (AOT ``lower().compile()`` of a
+named model) to prime the cache before the real job.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .monitor import metrics as _mx
+
+__all__ = ["setup_compile_cache", "compile_cache_dir", "is_configured"]
+
+# Registered at import so the counters exist (value 0) even when the cache
+# is off — tools/dump_metrics --selftest asserts their presence.
+_m_hit = _mx.counter("compile_cache/hit",
+                     help="XLA executables loaded from the persistent "
+                          "compile cache (PADDLE_TPU_COMPILE_CACHE)")
+_m_miss = _mx.counter("compile_cache/miss",
+                      help="XLA compiles that went to the compiler and were "
+                           "written to the persistent cache")
+
+_configured = False
+
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+
+def compile_cache_dir() -> Optional[str]:
+    """The configured cache directory, or None when the env var is unset."""
+    return os.environ.get("PADDLE_TPU_COMPILE_CACHE") or None
+
+
+def is_configured() -> bool:
+    return _configured
+
+
+def _on_event(event: str, **kwargs) -> None:
+    if event == _HIT_EVENT:
+        _m_hit.inc()
+    elif event == _MISS_EVENT:
+        _m_miss.inc()
+
+
+def setup_compile_cache(path: Optional[str] = None) -> bool:
+    """Point JAX's persistent compilation cache at ``path`` (default: the
+    ``PADDLE_TPU_COMPILE_CACHE`` env var) and hook the hit/miss counters.
+
+    Idempotent; returns True when the cache is (now) configured. Called at
+    ``paddle_tpu`` import, so setting the env var is all a job needs — but
+    it can also be called explicitly before any compile to enable the cache
+    programmatically.
+    """
+    global _configured
+    if _configured:
+        return True
+    path = path or compile_cache_dir()
+    if not path:
+        return False
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", os.path.abspath(path))
+    # Cache EVERYTHING: the default min-size/min-compile-time thresholds
+    # exist to keep the cache small, but they also make warm-start silently
+    # not happen for small models — the worst failure mode for a knob whose
+    # whole point is predictable restart latency.
+    for knob, val in (("jax_persistent_cache_min_entry_size_bytes", 0),
+                      ("jax_persistent_cache_min_compile_time_secs", 0)):
+        try:
+            jax.config.update(knob, val)
+        except AttributeError:  # older jax without the knob
+            pass
+    try:
+        from jax._src import monitoring as _jmon
+
+        # register once; _configured guards re-registration
+        _jmon.register_event_listener(_on_event)
+    except Exception:
+        # counters stay at 0 but the on-disk cache still works
+        pass
+    _configured = True
+    return True

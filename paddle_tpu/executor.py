@@ -36,7 +36,7 @@ from .core.registry import OpContext, get_op_impl
 from .core.scope import Scope, global_scope
 from .monitor import GRAD_NORM_VAR, metrics as _mx, tracer as _tr
 
-__all__ = ["Executor", "TraceContext"]
+__all__ = ["Executor", "FetchHandle", "TraceContext"]
 
 # Instruments are module-level handles: looked up once, so the per-run cost
 # with metrics ON is a few lock+add ops, and with metrics OFF a single
@@ -59,6 +59,21 @@ _m_feed_bytes = _mx.counter("executor/feed_bytes",
                             help="bytes handed to the step as feeds")
 _m_fetch_bytes = _mx.counter("executor/fetch_bytes",
                              help="bytes fetched back to host")
+_m_plan_hit = _mx.counter("executor/plan_hit",
+                          help="dispatch-plan cache hits (near-zero Python "
+                               "bookkeeping per step)")
+_m_plan_miss = _mx.counter("executor/plan_miss",
+                           help="dispatch-plan cache misses (full per-run "
+                                "bookkeeping)")
+_m_chain_dispatches = _mx.counter(
+    "executor/run_steps_dispatches",
+    help="fused multi-step dispatches issued by Executor.run_steps")
+_m_chain_steps = _mx.counter(
+    "executor/run_steps_steps",
+    help="train steps rolled into run_steps dispatches")
+_m_chain_ms = _mx.histogram(
+    "executor/run_steps_chunk_ms",
+    help="host dispatch wall time of one fused run_steps chunk")
 _m_hbm_used = _mx.gauge("device/hbm_bytes_in_use",
                         help="memory_stats bytes_in_use, summed over devices")
 _m_hbm_limit = _mx.gauge("device/hbm_bytes_limit",
@@ -114,6 +129,138 @@ def _nbytes(arrays) -> int:
         total += nb
     return total
 
+class FetchHandle:
+    """Deferred fetch result: ``run(..., return_numpy=False)`` returns one.
+
+    Holds the step's fetched ``jax.Array``\\ s, which may still be computing
+    on an async backend — so steady-state training can dispatch step N+1
+    while step N's device work is in flight. All host-side resolve work (the
+    numpy conversion, the opt-in ``PADDLE_TPU_GRAD_NORM`` gauge read and the
+    ``executor/fetch_bytes`` accounting) is deferred to :meth:`numpy`, which
+    is the only method that forces a device→host transfer.
+
+    The sequence protocol (``len``/index/unpack) hands back the raw device
+    arrays WITHOUT a sync, so existing ``loss, = exe.run(...,
+    return_numpy=False)`` call sites keep their non-blocking behavior.
+    """
+
+    __slots__ = ("_values", "_names", "_aux", "_np", "_aux_done")
+
+    def __init__(self, values, names, aux=None):
+        self._values = list(values)
+        self._names = tuple(names)
+        self._aux = aux  # hidden grad-norm fetch (device scalar) or None
+        self._np = None
+        self._aux_done = aux is None
+
+    @property
+    def names(self):
+        return self._names
+
+    @property
+    def raw(self):
+        """The fetched device arrays, no sync."""
+        return list(self._values)
+
+    def __len__(self):
+        return len(self._values)
+
+    def __getitem__(self, i):
+        return self._values[i]
+
+    def __iter__(self):
+        return iter(self._values)
+
+    def _consume_aux(self):
+        """Mirror the hidden grad-norm fetch into its gauge (one scalar
+        device sync; only on the resolve path, never at dispatch)."""
+        if self._aux_done:
+            return
+        self._aux_done = True
+        if not _mx._enabled:
+            return
+        try:
+            _m_grad_norm.set(float(np.asarray(self._aux).ravel()[-1]))
+        except (TypeError, ValueError):
+            pass
+
+    def done(self) -> bool:
+        """True once every fetched array's device computation finished
+        (non-blocking; conservatively True on backends without is_ready)."""
+        for v in self._values:
+            ready = getattr(v, "is_ready", None)
+            if ready is not None and not ready():
+                return False
+        return True
+
+    def block(self):
+        """Wait for the device work behind the fetches; returns self."""
+        jax.block_until_ready(self._values)
+        self._consume_aux()
+        return self
+
+    def numpy(self):
+        """Resolve to host numpy arrays (syncs; cached after first call)."""
+        if self._np is None:
+            out = [np.asarray(v) for v in self._values]
+            self._consume_aux()
+            if _mx._enabled and out:
+                _m_fetch_bytes.inc(_nbytes(out))
+            self._np = out
+        return list(self._np)
+
+    # the "resolve path" name used in docs; same operation
+    resolve = numpy
+
+    def __del__(self):
+        # A dropped handle must not silently lose the grad-norm sample the
+        # user opted into; this is a scalar sync at GC time, best-effort.
+        try:
+            self._consume_aux()
+        except Exception:
+            pass
+
+
+def _enforce_step_flags(fetch_names, fetches, state):
+    """FLAGS_benchmark device sync (reference: operator.cc:942) and
+    FLAGS_check_nan_inf post-step scan (operator.cc:947) — the one epilogue
+    both drivers (run() and run_steps) must apply identically."""
+    if _flags.benchmark:
+        jax.block_until_ready((state, fetches))
+    if _flags.check_nan_inf:
+        for label, val in list(zip(fetch_names, fetches)) + list(state.items()):
+            arr = np.asarray(val)
+            if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
+                raise RuntimeError(
+                    "FLAGS_check_nan_inf: non-finite values in %r after op "
+                    "execution" % label)
+
+
+def _mesh_repl(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
+
+
+def _mesh_batch_spec(mesh, leading_step_axis=False):
+    """PartitionSpec for feed batches: the batch axis shards over ``data``;
+    ``leading_step_axis`` prepends a replicated axis for run_steps' stacked
+    (step, batch, ...) chain feeds. One definition so the single-step and
+    chain drivers can never lay feeds out differently."""
+    from jax.sharding import PartitionSpec as P
+
+    if "data" not in mesh.axis_names:
+        return P()
+    return P(None, "data") if leading_step_axis else P("data")
+
+
+def _valid_sharding(spec, mesh):
+    """A Variable.sharding annotation applies iff every named axis exists on
+    this mesh — the one predicate all sharding consumers share."""
+    return spec is not None and all(
+        a is None or a in mesh.axis_names for a in spec)
+
+
 _UserCompiledProgram = None  # lazily bound CompiledProgram class (import cycle)
 
 
@@ -159,6 +306,10 @@ class TraceContext:
 def _canon(value, dtype_name: str):
     target = to_jnp_dtype(dtype_name)
     canonical = jax.dtypes.canonicalize_dtype(target)
+    if isinstance(value, jax.ShapeDtypeStruct):
+        # abstract feed (Executor.prepare): only shape/dtype matter
+        return (value if value.dtype == canonical
+                else jax.ShapeDtypeStruct(value.shape, canonical))
     if isinstance(value, jax.Array):
         # already on device (e.g. via DevicePrefetcher) — never round-trip to host
         return value if value.dtype == canonical else value.astype(canonical)
@@ -366,11 +517,16 @@ class _CompiledStep:
             fetches = [env[f] for f in self.fetch_names]
             return new_state, fetches
 
+        # the raw (unjitted) step closure: _CompiledStepChain scans over it
+        # to fuse k steps into one dispatch (Executor.run_steps)
+        self._step_fn = step
+        self.jitted = bool(jit)
+
         if jit and mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            repl = NamedSharding(mesh, P())
-            batch_spec = P("data") if "data" in mesh.axis_names else P()
+            repl = _mesh_repl(mesh)
+            batch_spec = _mesh_batch_spec(mesh)
             feed_sh = {n: NamedSharding(mesh, batch_spec) for n in feed_names}
             # State shardings come from the arrays themselves (the executor
             # device_puts them per Variable.sharding annotations). Output state
@@ -381,8 +537,7 @@ class _CompiledStep:
             for n in state_names:
                 v = program.global_block._find_var_recursive(n)
                 spec = getattr(v, "sharding", None) if v is not None else None
-                if spec is not None and all(
-                        a is None or a in mesh.axis_names for a in spec):
+                if _valid_sharding(spec, mesh):
                     out_state_sh[n] = NamedSharding(mesh, P(*spec))
                 else:
                     out_state_sh[n] = repl
@@ -401,19 +556,110 @@ class _CompiledStep:
         return self.fn(state, feeds, step_idx)
 
 
+class _CompiledStepChain:
+    """``length`` consecutive steps of a ``_CompiledStep`` fused into ONE
+    dispatched call.
+
+    ``lax.scan`` rolls the base step over feed batches stacked on a new
+    leading axis — the same stack-and-scan shape plumbing the gradient
+    accumulation path uses for microbatches, except here each scan iteration
+    is a FULL step (forward, backward, optimizer update) threading the state
+    carry, so host dispatch cost drops to 1/length while the traced program
+    (and its RNG stream: ``fold_in(key, step_idx)`` with the step index
+    carried through the scan) stays identical to ``length`` separate runs.
+    Per-step fetches come back stacked on the leading axis.
+    """
+
+    def __init__(self, base: _CompiledStep, length: int):
+        self.base = base
+        self.length = int(length)
+        step_fn = base._step_fn
+
+        def chain(state, stacked_feeds, step_idx0):
+            def body(carry, feeds):
+                st, idx = carry
+                new_st, fetches = step_fn(st, feeds, idx)
+                return (new_st, idx + jnp.uint32(1)), fetches
+
+            # explicit length: a feedless (state-only) program hands scan an
+            # empty xs pytree, which otherwise cannot infer the step count
+            (state, _), fetches = jax.lax.scan(
+                body, (state, jnp.uint32(step_idx0)), stacked_feeds,
+                length=self.length)
+            return state, fetches
+
+        if base.jitted and base.mesh is not None:
+            from jax.sharding import NamedSharding
+
+            mesh = base.mesh
+            repl = _mesh_repl(mesh)
+            # axis 0 is the step axis; the per-step batch axis (1) shards
+            # over ``data`` exactly like the single-step driver
+            spec = _mesh_batch_spec(mesh, leading_step_axis=True)
+            feed_sh = {n: NamedSharding(mesh, spec) for n in base.feed_names}
+            self.fn = jax.jit(chain, in_shardings=(None, feed_sh, repl),
+                              donate_argnums=(0,))
+        elif base.jitted:
+            self.fn = jax.jit(chain, donate_argnums=(0,))
+        else:
+            self.fn = chain
+
+    def __call__(self, state, stacked_feeds, step_idx0):
+        return self.fn(state, stacked_feeds, step_idx0)
+
+
+class _DispatchPlan:
+    """Memoized per-run Python bookkeeping for one (program version, feed
+    names/dtypes, fetch list) shape of ``Executor.run``.
+
+    A cache-hit step skips the per-feed ``block.var`` + dtype
+    canonicalization machinery, the feed-signature build, the persistable
+    walk and the specialization-key construction — the bookkeeping that
+    dominated host dispatch time — and goes straight to the cached
+    ``_CompiledStep``. Plans live on the Program (keyed by version, see
+    ``Executor._resolve_plan``), so a version bump invalidates them and they
+    die with the Program.
+    """
+
+    __slots__ = ("feed_specs", "fetch_names", "run_fetch_names",
+                 "grad_norm_fetch", "state_names", "avail_names", "compiled",
+                 "key", "put_specs", "batch_sh", "mesh_repl")
+
+    def __init__(self, feed_specs, fetch_names, run_fetch_names,
+                 grad_norm_fetch, state_names, avail_names, compiled, key,
+                 put_specs=None, batch_sh=None, mesh_repl=None):
+        self.feed_specs = feed_specs  # tuple of (name, np.dtype, shape)
+        self.fetch_names = fetch_names
+        self.run_fetch_names = run_fetch_names
+        self.grad_norm_fetch = grad_norm_fetch
+        self.state_names = state_names
+        self.avail_names = avail_names  # state vars present at plan build
+        self.compiled = compiled
+        self.key = key  # the _CompiledStep cache key (chain keys derive from it)
+        self.put_specs = put_specs  # mesh only: {name: NamedSharding}
+        self.batch_sh = batch_sh
+        self.mesh_repl = mesh_repl
+
+
 class Executor:
     """reference: python/paddle/fluid/executor.py:262."""
 
     def __init__(self, place: Optional[Place] = None):
         self.place = place
-        self._cache: Dict[tuple, _CompiledStep] = {}
-        self._step_counters: Dict[int, int] = {}
-        # persistable-name tuples are cached on each Program (see run()):
-        # recomputed only on version bump, freed with the Program. Walking
-        # every program var per run() was the single largest host cost.
+        self._cache: Dict[tuple, Any] = {}
+        self._dev = None  # get_device(place), resolved lazily once
+        self._dev_resolved = False
+        # Per-program state (persistable-name tuples, dispatch plans, the
+        # step counter feeding the per-step RNG) is cached ON each Program:
+        # recomputed only on version bump, freed with the Program. An
+        # executor-held dict keyed by id(program) would grow one entry per
+        # program forever and could silently serve stale state after id()
+        # reuse — the bug close() used to leave behind in _step_counters.
 
     def close(self):
-        """Parity with executor.py:388 (pserver notify) — nothing to release."""
+        """Parity with executor.py:388 (pserver notify): drop every cached
+        specialization. Per-program bookkeeping (dispatch plans, step
+        counters) lives on the Program objects and dies with them."""
         self._cache.clear()
 
     # -- helpers --------------------------------------------------------------
@@ -441,14 +687,47 @@ class Executor:
                 state[n] = val
         return state
 
-    def _rng_key(self, program: Program):
+    @staticmethod
+    def _unwrap_program(program, scope):
+        """(plain program, mesh, accumulation_steps) from a possibly-wrapped
+        CompiledProgram — the shared front door of run_steps and prepare
+        (run() instead routes through CompiledProgram._run)."""
+        global _UserCompiledProgram
+        if _UserCompiledProgram is None:
+            from .compiler import CompiledProgram as _cp
+
+            _UserCompiledProgram = _cp
+        mesh = None
+        accumulation_steps = 1
+        if isinstance(program, _UserCompiledProgram):
+            cp = program
+            cp._apply_build_passes(scope)
+            mesh = cp._mesh()
+            cp._apply_reduce_strategy(mesh)
+            if cp._build_strategy is not None:
+                accumulation_steps = getattr(
+                    cp._build_strategy, "gradient_accumulation_steps", 1)
+            program = cp._program
+        if program is None:
+            program = default_main_program()
+        return program, mesh, accumulation_steps
+
+    @staticmethod
+    def _next_step_index(program: Program, n: int = 1):
         """Per-step PRNG: only a uint32 step index crosses the host/device
         boundary; the fold_in runs inside the compiled step (this eager key
-        construction used to cost ~70% of per-step host overhead)."""
-        pid = id(program)
-        step = self._step_counters.get(pid, 0)
-        self._step_counters[pid] = step + 1
+        construction used to cost ~70% of per-step host overhead). The
+        counter lives on the Program so it dies with it and a fused
+        ``run_steps`` chunk advances it by the number of steps it rolled."""
+        step = getattr(program, "_tpu_step_counter", 0)
+        program._tpu_step_counter = step + n
         return np.uint32(step)
+
+    def _device(self):
+        if not self._dev_resolved:
+            self._dev = get_device(self.place)
+            self._dev_resolved = True
+        return self._dev
 
     # -- the public API -------------------------------------------------------
     def run(
@@ -508,121 +787,19 @@ class Executor:
                     "feed all of them or none" % (fed, list(reader.var_names)))
         fetch_names = self._fetch_names(fetch_list)
 
-        block = program.global_block
         # hot-path guards read the module flags directly: with metrics and
         # tracing both off, the whole observability layer costs these two
         # attribute loads + branches per run — no lock, no allocation
         mx_on = _mx._enabled
         tr_on = _tr._active
-        # Opt-in grad-norm gauge: the probe var is non-persistable (kept out
-        # of checkpoints and the state signature), so it reaches the host as
-        # a hidden extra fetch appended to the user's fetch list.
-        grad_norm_fetch = (mx_on and GRAD_NORM_VAR in block.vars
-                           and GRAD_NORM_VAR not in fetch_names)
-        run_fetch_names = (fetch_names + (GRAD_NORM_VAR,)
-                           if grad_norm_fetch else fetch_names)
-        feeds = {}
-        feed_sig = []
-        for name in sorted(feed):
-            var = block.var(name) if block.has_var(name) else None
-            dtype = var.dtype if var is not None else np.asarray(feed[name]).dtype.name
-            arr = _canon(feed[name], dtype)
-            feeds[name] = arr
-            feed_sig.append((name, arr.shape, str(arr.dtype)))
 
-        # cache lives ON the Program (keyed by version) so it dies with it —
-        # an executor-held dict keyed by id(program) leaks entries per
-        # mutation and can silently serve a stale tuple after id() reuse
-        cached = getattr(program, "_pnames_cache_entry", None)
-        if cached is not None and cached[0] == program._version:
-            state_names = cached[1]
-        else:
-            state_names = self._persistable_names(program, scope)
-            program._pnames_cache_entry = (program._version, state_names)
-        # state vars that actually exist (startup creates them on first run);
-        # iteration follows the pre-sorted state_names so no per-step re-sort
-        state = {}
-        svars = scope.vars
-        for n in state_names:
-            v = svars.get(n)
-            if v is None and scope.parent is not None:
-                v = scope.find_var(n)
-            if v is not None:
-                state[n] = v
-        avail_state_names = tuple(state)
+        plan, feeds, state, was_miss = self._resolve_plan(
+            program, feed, fetch_names, scope, mesh, accumulation_steps,
+            mx_on, tr_on, use_program_cache)
+        compiled = plan.compiled
 
-        is_test = in_test_mode()
-        is_training_or_has_feed = bool(feeds) or bool(fetch_names)
-        key = (
-            id(program),
-            program._version,
-            tuple(feed_sig),
-            run_fetch_names,
-            avail_state_names,
-            is_test,
-            id(mesh) if mesh is not None else None,
-            accumulation_steps,
-        )
-        compiled = self._cache.get(key) if use_program_cache else None
-        was_miss = compiled is None
-        if compiled is None:
-            from .log import vlog
-
-            vlog(1, "Executor: compiling new step specialization "
-                    "(program v%s, %d feeds, fetch=%s, test=%s)",
-                 program._version, len(feed_sig), list(fetch_names), is_test)
-            if mx_on:
-                _m_cache_miss.inc()
-            t_build = time.perf_counter() if mx_on else 0.0
-            with _tr.span("executor/trace_setup", cat="executor",
-                          args={"program_version": program._version,
-                                "n_feeds": len(feed_sig)}) if tr_on \
-                    else _NULL_CTX:
-                compiled = _CompiledStep(
-                    program,
-                    tuple(sorted(feeds)),
-                    run_fetch_names,
-                    state_names,
-                    is_test=is_test,
-                    jit=is_training_or_has_feed,
-                    mesh=mesh,
-                    accumulation_steps=accumulation_steps,
-                )
-            if mx_on:
-                _m_trace_ms.observe((time.perf_counter() - t_build) * 1e3)
-            if use_program_cache:
-                self._cache[key] = compiled
-        elif mx_on:
-            _m_cache_hit.inc()
-
-        rng_key = self._rng_key(program)
-        if mesh is not None:
-            # Lay out state across the mesh: replicated by default (the Fluid
-            # BCastParamsToDevices moment, parallel_executor.cc:340), or per
-            # Variable.sharding annotation (model-parallel params, sharded
-            # embeddings). Feeds shard on the data axis. No-op when already
-            # laid out correctly.
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            repl = NamedSharding(mesh, P())
-            specs = {}
-            for v in program.list_vars():
-                spec = getattr(v, "sharding", None)
-                if spec is not None and all(a is None or a in mesh.axis_names for a in spec):
-                    specs[v.name] = NamedSharding(mesh, P(*spec))
-            batch_sh = NamedSharding(mesh, P("data") if "data" in mesh.axis_names else P())
-            state = {k: jax.device_put(v, specs.get(k, repl)) for k, v in state.items()}
-            feeds = {k: jax.device_put(v, batch_sh) for k, v in feeds.items()}
-        else:
-            dev = get_device(self.place)
-            if dev is not None and feeds:
-                # jax.Arrays already on the right device skip the device_put —
-                # re-placing them every step costs real host time. Arrays
-                # committed elsewhere (e.g. fetched from a CPU executor) still
-                # get moved like before.
-                feeds = {k: v if isinstance(v, jax.Array) and dev in v.devices()
-                         else jax.device_put(v, dev)
-                         for k, v in feeds.items()}
+        rng_key = self._next_step_index(program)
+        state, feeds = self._place(plan, state, feeds, mesh)
         t_step = time.perf_counter() if mx_on else 0.0
         if tr_on:
             with _tr.span("executor/compile_and_step" if was_miss
@@ -645,41 +822,572 @@ class Executor:
             # steady-state dispatch path
             if was_miss or int(_m_runs.value) % _HBM_SAMPLE_EVERY == 0:
                 _update_hbm_gauges()
-        if grad_norm_fetch:
-            # opt-in (PADDLE_TPU_GRAD_NORM=1 at graph-build time): one
-            # scalar device sync per step
-            try:
-                _m_grad_norm.set(float(np.asarray(fetches[-1])))
-            except (TypeError, ValueError):
-                pass
+        aux = None
+        if plan.grad_norm_fetch:
+            # opt-in (PADDLE_TPU_GRAD_NORM=1 at graph-build time): the gauge
+            # read is a scalar device sync, so it rides the FetchHandle's
+            # resolve path instead of blocking the dispatch loop here
+            aux = fetches[-1]
             fetches = fetches[:-1]
 
-        if _flags.benchmark:
-            # per-step device sync (reference: FLAGS_benchmark operator.cc:942)
-            jax.block_until_ready((new_state, fetches))
-        if _flags.check_nan_inf:
-            # post-step NaN/Inf scan (reference: FLAGS_check_nan_inf
-            # operator.cc:947) over fetches + updated state
-            for label, val in list(zip(fetch_names, fetches)) + list(new_state.items()):
-                arr = np.asarray(val)
-                if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
-                    raise RuntimeError(
-                        "FLAGS_check_nan_inf: non-finite values in %r after op "
-                        "execution" % label)
+        _enforce_step_flags(fetch_names, fetches, new_state)
 
         for n, v in new_state.items():
             if v is not None:
                 scope.set_var(n, v)
 
         if not fetch_names:
+            if aux is not None:
+                # no user fetches to hang a handle on — keep the old eager
+                # gauge behavior instead of dropping the sample
+                FetchHandle((), (), aux)._consume_aux()
             return []
+        handle = FetchHandle(fetches, fetch_names, aux)
         if return_numpy:
-            out = [np.asarray(f) for f in fetches]
+            return handle.numpy()
+        return handle
+
+    # -- dispatch-plan machinery ----------------------------------------------
+    def _resolve_plan(self, program, feed, fetch_names, scope, mesh,
+                      accumulation_steps, mx_on, tr_on, use_program_cache):
+        """(plan, canonical feeds, state, was_compile_miss) for this run.
+
+        The hit path does near-zero bookkeeping: one dict lookup on the
+        Program-resident plan table plus a cheap per-feed shape/dtype check;
+        anything that doesn't match falls through to the full (slow) path,
+        which rebuilds the plan in place.
+        """
+        block = program.global_block
+        is_test = in_test_mode()
+        # Opt-in grad-norm gauge: the probe var is non-persistable (kept out
+        # of checkpoints and the state signature), so it reaches the host as
+        # a hidden extra fetch appended to the user's fetch list.
+        grad_norm_fetch = bool(mx_on and GRAD_NORM_VAR in block.vars
+                               and GRAD_NORM_VAR not in fetch_names)
+        feed_names = tuple(sorted(feed))
+        mesh_id = id(mesh) if mesh is not None else None
+        # shapes are part of the key so alternating batch shapes (the last
+        # partial batch of every epoch, train/eval interleave) each keep
+        # their own plan instead of thrashing one slot; non-array feeds
+        # (shape None) fall through to the per-feed spec check on hit
+        feed_shapes = tuple(getattr(feed[n], "shape", None)
+                            for n in feed_names)
+        plan_key = (feed_names, feed_shapes, fetch_names, is_test, mesh_id,
+                    accumulation_steps, grad_norm_fetch)
+
+        plans = None
+        if use_program_cache:
+            # plans live ON the Program (keyed by version) so they die with
+            # it — an executor-held dict keyed by id(program) leaks entries
+            # per mutation and can serve stale state after id() reuse
+            entry = getattr(program, "_dispatch_plans", None)
+            if entry is None or entry[0] != program._version:
+                entry = (program._version, {})
+                program._dispatch_plans = entry
+            plans = entry[1]
+            plan = plans.get(plan_key)
+            if plan is not None:
+                feeds = self._feeds_from_plan(plan, feed)
+                if feeds is not None:
+                    state = self._gather_plan_state(plan, scope)
+                    if state is not None:
+                        if mx_on:
+                            _m_plan_hit.inc()
+                            _m_cache_hit.inc()
+                        return plan, feeds, state, False
+
+        # ---- slow path: full per-run bookkeeping ----
+        if mx_on:
+            _m_plan_miss.inc()
+        feeds = {}
+        feed_sig = []
+        feed_specs = []
+        for name in feed_names:
+            var = block.var(name) if block.has_var(name) else None
+            if var is not None:
+                dtype = var.dtype
+            else:
+                v0 = feed[name]
+                dt0 = getattr(v0, "dtype", None)
+                dtype = str(dt0) if dt0 is not None else np.asarray(v0).dtype.name
+            arr = _canon(feed[name], dtype)
+            feeds[name] = arr
+            feed_sig.append((name, arr.shape, str(arr.dtype)))
+            feed_specs.append((name, np.dtype(arr.dtype), arr.shape))
+
+        cached = getattr(program, "_pnames_cache_entry", None)
+        if cached is not None and cached[0] == program._version:
+            state_names = cached[1]
         else:
-            out = list(fetches)
-        if mx_on and out:
-            _m_fetch_bytes.inc(_nbytes(out))
-        return out
+            state_names = self._persistable_names(program, scope)
+            program._pnames_cache_entry = (program._version, state_names)
+        # state vars that actually exist (startup creates them on first run);
+        # iteration follows the pre-sorted state_names so no per-step re-sort
+        state = {}
+        svars = scope.vars
+        for n in state_names:
+            v = svars.get(n)
+            if v is None and scope.parent is not None:
+                v = scope.find_var(n)
+            if v is not None:
+                state[n] = v
+        avail_state_names = tuple(state)
+
+        run_fetch_names = (fetch_names + (GRAD_NORM_VAR,)
+                           if grad_norm_fetch else fetch_names)
+        is_training_or_has_feed = bool(feeds) or bool(fetch_names)
+        key = (
+            id(program),
+            program._version,
+            tuple(feed_sig),
+            run_fetch_names,
+            avail_state_names,
+            is_test,
+            mesh_id,
+            accumulation_steps,
+        )
+        compiled = self._cache.get(key) if use_program_cache else None
+        was_miss = compiled is None
+        if compiled is None:
+            from .log import vlog
+
+            vlog(1, "Executor: compiling new step specialization "
+                    "(program v%s, %d feeds, fetch=%s, test=%s)",
+                 program._version, len(feed_sig), list(fetch_names), is_test)
+            if mx_on:
+                _m_cache_miss.inc()
+            t_build = time.perf_counter() if mx_on else 0.0
+            with _tr.span("executor/trace_setup", cat="executor",
+                          args={"program_version": program._version,
+                                "n_feeds": len(feed_sig)}) if tr_on \
+                    else _NULL_CTX:
+                compiled = _CompiledStep(
+                    program,
+                    feed_names,
+                    run_fetch_names,
+                    state_names,
+                    is_test=is_test,
+                    jit=is_training_or_has_feed,
+                    mesh=mesh,
+                    accumulation_steps=accumulation_steps,
+                )
+            if mx_on:
+                _m_trace_ms.observe((time.perf_counter() - t_build) * 1e3)
+            if use_program_cache:
+                self._cache[key] = compiled
+        elif mx_on:
+            _m_cache_hit.inc()
+
+        put_specs = batch_sh = mesh_repl = None
+        if mesh is not None:
+            # Mesh layout is a function of (program version, mesh) — memoize
+            # the annotation walk on the plan instead of re-walking every
+            # program var per run. Placement itself stays per-run (values
+            # change); see _place.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            mesh_repl = _mesh_repl(mesh)
+            put_specs = {}
+            for v in program.list_vars():
+                spec = getattr(v, "sharding", None)
+                if _valid_sharding(spec, mesh):
+                    put_specs[v.name] = NamedSharding(mesh, P(*spec))
+            batch_sh = NamedSharding(mesh, _mesh_batch_spec(mesh))
+
+        plan = _DispatchPlan(tuple(feed_specs), fetch_names, run_fetch_names,
+                             grad_norm_fetch, state_names, avail_state_names,
+                             compiled, key, put_specs, batch_sh, mesh_repl)
+        if plans is not None:
+            plans[plan_key] = plan
+        return plan, feeds, state, was_miss
+
+    @staticmethod
+    def _feeds_from_plan(plan, feed):
+        """Canonicalize ``feed`` against the plan's recorded dtypes; None on
+        any shape mismatch (caller falls back to the slow path)."""
+        feeds = {}
+        for name, dt, shp in plan.feed_specs:
+            v = feed[name]
+            if isinstance(v, jax.ShapeDtypeStruct):
+                if v.dtype != dt:
+                    v = jax.ShapeDtypeStruct(v.shape, dt)
+            else:
+                if not isinstance(v, jax.Array):
+                    v = np.asarray(v)
+                if v.dtype != dt:
+                    v = v.astype(dt)
+            if v.shape != shp:
+                return None
+            feeds[name] = v
+        return feeds
+
+    @staticmethod
+    def _gather_plan_state(plan, scope):
+        state = {}
+        svars = scope.vars
+        parent = scope.parent
+        for n in plan.state_names:
+            v = svars.get(n)
+            if v is None and parent is not None:
+                v = scope.find_var(n)
+            if v is not None:
+                state[n] = v
+        if tuple(state) != plan.avail_names:
+            # scope membership changed since the plan was built (a var
+            # loaded/erased — including same-COUNT swaps from partial
+            # checkpoint loads) — rebuild so the specialization key, which
+            # is keyed on the exact available-state tuple, stays honest
+            return None
+        return state
+
+    def _place(self, plan, state, feeds, mesh):
+        if mesh is not None:
+            # Lay out state across the mesh: replicated by default (the Fluid
+            # BCastParamsToDevices moment, parallel_executor.cc:340), or per
+            # Variable.sharding annotation (model-parallel params, sharded
+            # embeddings). Feeds shard on the data axis. No-op when already
+            # laid out correctly.
+            repl = plan.mesh_repl
+            specs = plan.put_specs
+            state = {k: jax.device_put(v, specs.get(k, repl))
+                     for k, v in state.items()}
+            feeds = {k: jax.device_put(v, plan.batch_sh)
+                     for k, v in feeds.items()}
+        else:
+            dev = self._device()
+            if dev is not None and feeds:
+                # jax.Arrays already on the right device skip the device_put —
+                # re-placing them every step costs real host time. Arrays
+                # committed elsewhere (e.g. fetched from a CPU executor) still
+                # get moved like before.
+                feeds = {k: v if isinstance(v, jax.Array) and dev in v.devices()
+                         else jax.device_put(v, dev)
+                         for k, v in feeds.items()}
+        return state, feeds
+
+    # -- fused multi-step driver ----------------------------------------------
+    def run_steps(
+        self,
+        program: Optional[Program] = None,
+        feed_iter=None,
+        steps: Optional[int] = None,
+        fetch_list: Optional[Sequence] = None,
+        fetch_every: int = 1,
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+    ):
+        """Drive up to ``steps`` training steps, fusing ``fetch_every``
+        consecutive steps into ONE dispatched call (a ``lax.scan`` over feed
+        batches stacked on a new leading axis), so host dispatch cost per
+        step drops to 1/``fetch_every`` and state never round-trips through
+        the scope between fused steps.
+
+        ``feed_iter`` yields one feed dict per step — a plain iterator, a
+        generator, or a :class:`~paddle_tpu.reader.DevicePrefetcher` (its
+        batches are drained directly; if run_steps is what starts it, it
+        also stops it on return, so an early exit at ``steps`` never leaves
+        the worker thread pinning device buffers — pre-start it or use its
+        context manager to keep ownership). When omitted, started
+        ``py_reader``\\ s bound to the program are drained instead, stopping
+        cleanly at EOF. ``steps=None`` runs until the feed source is
+        exhausted. A feed-shape change between chunks (the final partial
+        batch of an epoch) transparently re-resolves the dispatch plan,
+        like ``run()``'s per-shape plans.
+
+        Returns per-step fetch rows (``return_numpy=True``: a list of
+        ``[np.ndarray, ...]`` rows, bit-identical to ``steps`` individual
+        ``run()`` calls) or one :class:`FetchHandle` per fused dispatch
+        (``return_numpy=False``; a multi-step chunk's handle resolves to
+        arrays whose leading axis is that chunk's step count, a
+        single-step chunk's to plain per-fetch arrays like ``run()``).
+        """
+        program, mesh, accumulation_steps = self._unwrap_program(program, scope)
+        if scope is None:
+            scope = global_scope()
+        fetch_names = self._fetch_names(fetch_list)
+        k = max(1, int(fetch_every))
+
+        owned_prefetcher = None
+        if feed_iter is None:
+            readers = [r for r in getattr(program, "_py_readers", ())
+                       if r._started]
+            if not readers:
+                raise ValueError(
+                    "run_steps() needs a feed_iter or a started py_reader "
+                    "bound to the program")
+            from .reader.py_reader import EOFException
+
+            def _drain_readers():
+                while True:
+                    f = {}
+                    try:
+                        for r in readers:
+                            f.update(r.next_feed())
+                    except EOFException:
+                        return
+                    yield f
+
+            feed_iter = _drain_readers()
+        else:
+            from .reader.prefetcher import DevicePrefetcher
+
+            if (isinstance(feed_iter, DevicePrefetcher)
+                    and feed_iter._thread is None):
+                # we start it (via iter below), so we own its lifecycle:
+                # stop it on exit so an early return at ``steps`` doesn't
+                # leave the worker blocked holding device buffers. A
+                # caller-started prefetcher (start() / context manager) is
+                # the caller's to stop.
+                owned_prefetcher = feed_iter
+            feed_iter = iter(feed_iter)
+
+        def _shape_sig(f):
+            """(signature, feed) — list/scalar feed values (run() accepts
+            them too) are converted to numpy ONCE here; the returned feed
+            carries the converted arrays so canon never re-converts."""
+            sig = []
+            conv = None
+            for n in sorted(f):
+                v = f[n]
+                shp = getattr(v, "shape", None)
+                if shp is None:
+                    v = np.asarray(v)
+                    if conv is None:
+                        conv = dict(f)
+                    conv[n] = v
+                    shp = v.shape
+                sig.append((n, tuple(shp)))
+            return tuple(sig), (conv if conv is not None else f)
+
+        mx_on = _mx._enabled
+        tr_on = _tr._active
+        rows: List[Any] = []      # return_numpy=True: one row per step
+        handles: List[FetchHandle] = []  # else: one handle per fused chunk
+        state = None
+        plan = None
+        consumed = 0
+        pending = None  # lookahead feed cut from the previous chunk
+        try:
+            while steps is None or consumed < steps:
+                want = k if steps is None else min(k, steps - consumed)
+                chunk = []
+                sig0 = None
+                while len(chunk) < want:
+                    if pending is not None:
+                        f, pending = pending, None
+                    else:
+                        try:
+                            f = next(feed_iter)
+                        except StopIteration:
+                            break
+                    sig, f = _shape_sig(f)
+                    if chunk and sig != sig0:
+                        # shape boundary (the epoch's final partial batch):
+                        # cut the chunk here — stacking needs uniform
+                        # shapes — and carry the odd feed into the next
+                        # chunk, where the plan re-resolves for it
+                        pending = f
+                        break
+                    sig0 = sig
+                    chunk.append(f)
+                if not chunk:
+                    break
+
+                chunk_was_miss = False
+                if plan is not None:
+                    try:
+                        chunk_feeds = [self._canon_chunk_feed(plan, f)
+                                       for f in chunk]
+                    except ValueError:
+                        # the feed shape changed mid-stream (the final
+                        # partial batch of a real epoch): flush the live
+                        # carry to the scope and re-resolve a plan for the
+                        # new shape — mirrors run()'s per-shape plans. A
+                        # shape mix WITHIN one chunk still raises below
+                        # (it cannot be stacked).
+                        for name, v in state.items():
+                            if v is not None:
+                                scope.set_var(name, v)
+                        plan = None
+                if plan is None:
+                    plan, feeds0, state, chunk_was_miss = self._resolve_plan(
+                        program, chunk[0], fetch_names, scope, mesh,
+                        accumulation_steps, mx_on, tr_on, True)
+                    chunk_feeds = [feeds0]
+                    chunk_feeds += [self._canon_chunk_feed(plan, f)
+                                    for f in chunk[1:]]
+                    state, _ = self._place(plan, state, {}, mesh)
+
+                n = len(chunk_feeds)
+                step_idx0 = self._next_step_index(program, n)
+                if n == 1:
+                    _, stacked = self._place(plan, {}, chunk_feeds[0], mesh)
+                    compiled = plan.compiled
+                else:
+                    stacked = {name: jnp.stack([f[name] for f in chunk_feeds])
+                               for name, _, _ in plan.feed_specs}
+                    if mesh is None:
+                        _, stacked = self._place(plan, {}, stacked, mesh)
+                    # with a mesh, the chain's in_shardings (step axis
+                    # replicated, batch axis over ``data``) lay the stack out
+                    compiled, chain_miss = self._chain_for(plan, n)
+                    chunk_was_miss = chunk_was_miss or chain_miss
+
+                t0 = time.perf_counter() if mx_on else 0.0
+                if tr_on:
+                    with _tr.span("executor/run_steps_chunk", cat="executor",
+                                  args={"steps": n}):
+                        state, fetches = compiled(state, stacked, step_idx0)
+                else:
+                    state, fetches = compiled(state, stacked, step_idx0)
+                if mx_on:
+                    # a fresh specialization/chain pays its jit trace + XLA
+                    # compile on this first call — route that to the compile
+                    # histogram so the steady-state chunk histogram stays
+                    # clean, mirroring run()'s miss/hit split
+                    (_m_compile_ms if chunk_was_miss else _m_chain_ms).observe(
+                        (time.perf_counter() - t0) * 1e3)
+                    _m_chain_dispatches.inc()
+                    _m_chain_steps.inc(n)
+                    _m_feed_bytes.inc(_nbytes(stacked.values()))
+                    # keep the HBM signal alive for pipeline-driven jobs,
+                    # same sampling policy as run()
+                    if int(_m_chain_dispatches.value) % _HBM_SAMPLE_EVERY \
+                            in (1, 0):
+                        _update_hbm_gauges()
+                consumed += n
+
+                _enforce_step_flags(plan.run_fetch_names, fetches, state)
+
+                aux = None
+                if plan.grad_norm_fetch:
+                    aux = fetches[-1]
+                    fetches = fetches[:-1]
+                if not fetch_names:
+                    if aux is not None:
+                        FetchHandle((), (), aux)._consume_aux()
+                    continue
+                handle = FetchHandle(fetches, fetch_names, aux)
+                if not return_numpy:
+                    handles.append(handle)
+                elif n == 1:
+                    rows.append(handle.numpy())
+                else:
+                    arrs = handle.numpy()
+                    rows.extend([a[i] for a in arrs] for i in range(n))
+        finally:
+            # Donation consumed the scope's old state buffers at the first
+            # dispatch — write the live carry back even on an error mid-loop.
+            # Best-effort: if the FAILING dispatch itself already consumed
+            # the carry via donation, those arrays are deleted and writing
+            # them would poison the scope — skip them (recoverability after
+            # a post-donation failure is inherently limited, same as run()).
+            if state is not None:
+                for name, v in state.items():
+                    if v is None:
+                        continue
+                    if isinstance(v, jax.Array):
+                        deleted = getattr(v, "is_deleted", None)
+                        if deleted is not None and deleted():
+                            continue
+                    scope.set_var(name, v)
+            if owned_prefetcher is not None:
+                # we started it; stopping releases the worker thread and its
+                # buffered device batches when we return before exhaustion
+                owned_prefetcher.stop()
+
+        if not fetch_names:
+            return []
+        return rows if return_numpy else handles
+
+    def _canon_chunk_feed(self, plan, feed):
+        try:
+            feeds = self._feeds_from_plan(plan, feed)
+        except KeyError:  # a feed name vanished mid-stream
+            feeds = None
+        if feeds is None or len(feed) != len(plan.feed_specs):
+            raise ValueError(
+                "run_steps(): feed dict changed shape/dtype/names mid-stream; "
+                "expected %s" % [(n, str(d), s) for n, d, s in plan.feed_specs])
+        return feeds
+
+    def _chain_for(self, plan, length: int):
+        """(chain, was_miss) — the fused-chain specialization for ``plan``."""
+        key = plan.key + ("chain", length)
+        chain = self._cache.get(key)
+        was_miss = chain is None
+        if chain is None:
+            from .log import vlog
+
+            vlog(1, "Executor: building fused %d-step chain", length)
+            if _mx._enabled:
+                _m_cache_miss.inc()
+            chain = _CompiledStepChain(plan.compiled, length)
+            self._cache[key] = chain
+        elif _mx._enabled:
+            _m_cache_hit.inc()
+        return chain, was_miss
+
+    # -- AOT warmup -----------------------------------------------------------
+    def prepare(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[Sequence] = None,
+        scope: Optional[Scope] = None,
+    ):
+        """Ahead-of-time build + XLA-compile the step specialization for
+        ``feed`` WITHOUT executing it (the TVM-style AOT artifact path).
+
+        ``feed`` values may be real arrays, ``jax.ShapeDtypeStruct``\\ s, or
+        ``(shape, dtype)`` tuples — only shapes/dtypes matter. With
+        ``PADDLE_TPU_COMPILE_CACHE`` set, the XLA executable lands in the
+        persistent cache, so a later process (``tools/warmup.py`` then the
+        real job) skips the compile entirely. Accepts a ``CompiledProgram``
+        like ``run()`` (its mesh specialization is what gets AOT-compiled).
+        Returns the cached ``_CompiledStep``.
+        """
+        program, mesh, accumulation_steps = self._unwrap_program(program, scope)
+        if scope is None:
+            scope = global_scope()
+        feed = dict(feed or {})
+        block = program.global_block
+        abstract = {}
+        for name in sorted(feed):
+            v = feed[name]
+            if isinstance(v, jax.ShapeDtypeStruct):
+                abstract[name] = v
+                continue
+            if isinstance(v, tuple) and len(v) == 2 and not hasattr(v, "dtype"):
+                shape, dtype = v
+            else:
+                arr = v if hasattr(v, "shape") else np.asarray(v)
+                shape, dtype = arr.shape, arr.dtype
+            var = block.var(name) if block.has_var(name) else None
+            target = to_jnp_dtype(var.dtype) if var is not None else dtype
+            canonical = jax.dtypes.canonicalize_dtype(target)
+            abstract[name] = jax.ShapeDtypeStruct(tuple(shape), canonical)
+
+        fetch_names = self._fetch_names(fetch_list)
+        # the plan machinery accepts abstract feeds, so prepare() and a later
+        # run() at the same shapes share one plan + specialization entry
+        plan, _, state, _ = self._resolve_plan(
+            program, abstract, fetch_names, scope, mesh, accumulation_steps,
+            _mx._enabled, _tr._active, True)
+        compiled = plan.compiled
+        if not compiled.jitted:
+            return compiled
+        abstract_state = {
+            n: jax.ShapeDtypeStruct(tuple(getattr(v, "shape", ())),
+                                    getattr(v, "dtype", np.float32))
+            for n, v in state.items()}
+        t0 = time.perf_counter()
+        compiled.fn.lower(
+            abstract_state, abstract,
+            jax.ShapeDtypeStruct((), np.dtype("uint32"))).compile()
+        if _mx._enabled:
+            _m_compile_ms.observe((time.perf_counter() - t0) * 1e3)
+        return compiled
 
     # Fluid parity alias
     def infer_from_program(self, *a, **kw):

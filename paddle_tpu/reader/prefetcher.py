@@ -12,7 +12,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import Callable, Dict, Iterator, Optional
+from typing import Dict, Iterator, Optional
 
 import jax
 import numpy as np
@@ -35,6 +35,14 @@ class DevicePrefetcher:
 
         for feed in DevicePrefetcher(feed_iter(), capacity=2):
             exe.run(main, feed=feed, fetch_list=[loss])
+
+    Also a context manager: ``with DevicePrefetcher(...) as pf:`` guarantees
+    the worker thread is stopped (and its buffered device batches dropped)
+    when the block exits, even if the consumer abandons the loop early —
+    without ``stop()``, a walked-away-from iterator would leave the worker
+    blocked on a full queue forever, pinning ``capacity`` batches of device
+    memory. Worker exceptions surface in the consumer with the worker's
+    original traceback, as soon as the failing batch's slot is reached.
     """
 
     _END = object()
@@ -46,7 +54,9 @@ class DevicePrefetcher:
         self._device = device
         self._sharding = sharding
         self._thread: Optional[threading.Thread] = None
-        self._err = None
+        self._err: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._finished = False  # consumer saw _END: source exhausted
 
     def _target(self):
         if self._sharding is not None:
@@ -55,26 +65,98 @@ class DevicePrefetcher:
             return self._device
         return jax.devices()[0]
 
+    def _put(self, item) -> bool:
+        """Queue.put that stays responsive to stop(); False = stopping."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _worker(self):
         try:
             tgt = self._target()
             for feed in self._src:
+                if self._stop.is_set():
+                    return
                 if _mx.enabled():
                     t0 = time.perf_counter()
                     out = {k: jax.device_put(v, tgt) for k, v in feed.items()}
                     _m_h2d_ms.observe((time.perf_counter() - t0) * 1e3)
                 else:
                     out = {k: jax.device_put(v, tgt) for k, v in feed.items()}
-                self._q.put(out)
-        except Exception as e:  # propagate into the consumer
+                if not self._put(out):
+                    return
+        except BaseException as e:  # propagate into the consumer
+            # __traceback__ rides along, so the consumer's re-raise shows
+            # the worker frame that actually failed, not this one
             self._err = e
         finally:
-            self._q.put(self._END)
+            self._put(self._END)
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "DevicePrefetcher":
+        """Start the background H2D thread (idempotent; __iter__ calls it)."""
+        if self._stop.is_set():
+            raise RuntimeError("DevicePrefetcher was stopped; create a new one")
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._worker, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the worker and release its buffered device batches.
+
+        Safe to call from any state (not started / mid-iteration / already
+        stopped). After stop() the iterator terminates; a worker blocked on
+        the full queue is unblocked and exits instead of holding device
+        buffers for the life of the process.
+        """
+        self._stop.set()
+        q = self._q
+        while True:  # drop buffered batches so a blocked worker can exit
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        try:
+            # wake a consumer blocked in q.get(): the drain above may have
+            # swallowed the worker's _END, and a stopped worker won't enqueue
+            # another one
+            q.put_nowait(self._END)
+        except queue.Full:
+            pass
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=1.0)
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()
+
+    def _raise_worker_error(self):
+        err = self._err
+        self._err = None
+        # re-raising the stored exception keeps the worker thread's original
+        # traceback (its __traceback__) under this consumer-side frame
+        raise err
 
     def __iter__(self):
-        self._thread = threading.Thread(target=self._worker, daemon=True)
-        self._thread.start()
+        if self._finished:
+            # source already drained: a second epoch loop over the same
+            # prefetcher terminates immediately (there is one worker per
+            # prefetcher now, so waiting on the queue would block forever)
+            return
+        self.start()
         while True:
+            if self._stop.is_set():
+                if self._err is not None:
+                    self._raise_worker_error()
+                return
             if _mx.enabled():
                 _m_depth.set(self._q.qsize())
                 t0 = time.perf_counter()
@@ -83,7 +165,8 @@ class DevicePrefetcher:
             else:
                 item = self._q.get()
             if item is self._END:
+                self._finished = True
                 if self._err is not None:
-                    raise self._err
+                    self._raise_worker_error()
                 return
             yield item

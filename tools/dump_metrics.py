@@ -123,6 +123,37 @@ def selftest() -> int:
         assert {s["name"] for s in back} == {s["name"] for s in mine}
         assert sorted(s["dur_us"] for s in back) == sorted(
             s["dur_us"] for s in mine)
+    # 3. async pipeline: the compile-cache counter pair must exist and a
+    #    tiny fused run_steps loop must execute + instrument (CPU, ~1s)
+    import numpy as np
+
+    import paddle_tpu as fluid
+
+    snap = metrics.snapshot()
+    assert "compile_cache/hit" in snap, "compile-cache counters not registered"
+    assert "compile_cache/miss" in snap
+    with fluid.unique_name.guard():
+        with fluid.scope_guard(fluid.Scope()):
+            main_prog, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main_prog, startup):
+                x = fluid.layers.data("x", shape=[4])
+                y = fluid.layers.data("y", shape=[1], dtype="int64")
+                logits = fluid.layers.fc(x, size=2)
+                loss = fluid.layers.mean(
+                    fluid.layers.softmax_with_cross_entropy(logits, y))
+                fluid.optimizer.SGD(0.1).minimize(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            feeds = ({"x": rng.randn(2, 4).astype("float32"),
+                      "y": rng.randint(0, 2, (2, 1)).astype("int64")}
+                     for _ in range(4))
+            rows = exe.run_steps(main_prog, feeds, steps=4,
+                                 fetch_list=[loss], fetch_every=2)
+            assert len(rows) == 4 and np.isfinite(rows[-1][0]).all()
+            snap = metrics.snapshot()
+            assert snap["executor/run_steps_dispatches"]["value"] == 2
+            assert snap["executor/run_steps_steps"]["value"] == 4
     metrics.reset()
     print("dump_metrics selftest: OK")
     return 0
