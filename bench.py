@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import functools
 import json
+import os
 import sys
 import time
 
@@ -247,19 +248,30 @@ def bench_transformer(batch=64, seq=256, vocab=30000, use_amp=True,
     with fluid.unique_name.guard():
         with fluid.scope_guard(fluid.Scope()):
             main_prog, startup = fluid.Program(), fluid.Program()
-            with fluid.program_guard(main_prog, startup):
-                src = fluid.layers.data("src", shape=[seq], dtype="int64")
-                trg = fluid.layers.data("trg", shape=[seq], dtype="int64")
-                lbl = fluid.layers.data("lbl", shape=[seq, 1], dtype="int64")
-                smask = fluid.layers.data("smask", shape=[seq], dtype="float32")
-                tmask = fluid.layers.data("tmask", shape=[seq], dtype="float32")
-                logits, loss = tfm.transformer_base(
-                    src, trg, lbl, smask, tmask, src_vocab_size=vocab,
-                    trg_vocab_size=vocab, max_length=seq, dropout_rate=0.1)
-                opt = fluid.optimizer.Adam(learning_rate=1e-4)
-                if use_amp:
-                    opt = fluid.amp.decorate(opt)
-                opt.minimize(loss)
+            # build attention from primitives (the reference dist_transformer
+            # composition): the default trace-time optimizer's
+            # flash_attention_rewrite (PADDLE_TPU_OPT_LEVEL>=1) fuses the
+            # non-causal sites back onto the fused-attention op at prepare
+            # time — this config is the standing proof that primitive-built
+            # programs reach the Pallas kernel without opting in
+            prev_unfused = fluid.get_flag("unfused_attention")
+            fluid.set_flag("unfused_attention", True)
+            try:
+                with fluid.program_guard(main_prog, startup):
+                    src = fluid.layers.data("src", shape=[seq], dtype="int64")
+                    trg = fluid.layers.data("trg", shape=[seq], dtype="int64")
+                    lbl = fluid.layers.data("lbl", shape=[seq, 1], dtype="int64")
+                    smask = fluid.layers.data("smask", shape=[seq], dtype="float32")
+                    tmask = fluid.layers.data("tmask", shape=[seq], dtype="float32")
+                    logits, loss = tfm.transformer_base(
+                        src, trg, lbl, smask, tmask, src_vocab_size=vocab,
+                        trg_vocab_size=vocab, max_length=seq, dropout_rate=0.1)
+                    opt = fluid.optimizer.Adam(learning_rate=1e-4)
+                    if use_amp:
+                        opt = fluid.amp.decorate(opt)
+                    opt.minimize(loss)
+            finally:
+                fluid.set_flag("unfused_attention", prev_unfused)
 
             exe = fluid.Executor(fluid.TPUPlace(0))
             exe.run(startup)
@@ -1243,7 +1255,7 @@ def main():
                                              pipeline=pipeline)
     detail["transformer_bf16"] = {
         "examples_per_sec": round(tfm_eps, 2), "steps_per_sec": round(tfm_sps, 3),
-        **_last_spread()}
+        **_last_spread(), **_graph_opt_section()}
     if peak:
         fl = _transformer_train_flops_per_example(seq, vocab)
         detail["transformer_bf16"]["mfu_est"] = round(tfm_eps * fl / peak, 4)
@@ -1416,6 +1428,38 @@ def main():
         "metrics": _monitor_metrics_section(),
     }))
     return 0
+
+
+def _graph_opt_section():
+    """Trace-time optimizer evidence for the bench just run: global-block
+    op count entering/leaving the default pipeline (the gauges hold the
+    most recent pipeline application — i.e. this bench's program) and the
+    cumulative fused-pattern match counters. Trace/compile-time deltas vs
+    PADDLE_TPU_OPT_LEVEL=0 are measured by ``benchmarks/diag_overhead.py
+    --opt``; here the absolute trace+compile histograms land in the
+    ``metrics`` section."""
+    from paddle_tpu import monitor
+
+    snap = monitor.snapshot()
+
+    def val(name):
+        s = snap.get(name)
+        return int(s["value"]) if s and s.get("value") is not None else 0
+
+    before = val("passes/pipeline/op_count_before")
+    if not before:
+        return {}
+    from paddle_tpu.passes import opt_level
+
+    return {"graph_opt": {
+        "opt_level": opt_level(),
+        "op_count_before": before,
+        "op_count_after": val("passes/pipeline/op_count_after"),
+        "flash_attention_rewrites": val(
+            "passes/flash_attention_rewrite/rewrites_matched"),
+        "softmax_xent_rewrites": val(
+            "passes/softmax_xent_fuse_pass/rewrites_matched"),
+    }}
 
 
 def _monitor_metrics_section():

@@ -7,12 +7,20 @@ or bytes names the part of the traced program that raw JAX doesn't have.
 
 Usage: python benchmarks/diag_overhead.py          (on axon TPU)
        python benchmarks/diag_overhead.py --host   (any backend, incl. CPU)
+       python benchmarks/diag_overhead.py --opt    (any backend, incl. CPU)
 
 ``--host`` measures pure HOST dispatch overhead on a tiny MLP where device
 compute is negligible: per-step wall time of the cache-hit ``run()`` path
 (the dispatch-plan cache's hot path) and of the fused
 ``run_steps(fetch_every=8)`` driver, plus dispatches-per-step from the
 monitor counters — the number the async-pipeline work optimizes.
+
+``--opt`` is the CPU MLP probe for the default trace-time optimizer
+(paddle_tpu.passes): builds the same MLP with a metrics side branch and a
+constant chain, runs it at ``PADDLE_TPU_OPT_LEVEL=0`` and ``=1``, and
+reports traced-op count, trace+compile wall time of the first step, and a
+bit-identity check on the losses (dropout RNG included). Exits non-zero if
+level 1 fails to shrink the program or perturbs a loss bit.
 """
 
 from __future__ import annotations
@@ -92,6 +100,68 @@ def host_mode(steps=300, fetch_every=8):
                   % (rs_ms, fetch_every, n_disp / max(n_steps, 1)))
             print("dispatch_reduction          : %.1fx fewer dispatched "
                   "calls" % (n_steps / max(n_disp, 1)))
+
+
+def opt_mode(steps=6):
+    """CPU probe for PADDLE_TPU_OPT_LEVEL: op count + trace/compile time +
+    loss bit-identity, level 1 vs level 0 (ISSUE 3 acceptance gate)."""
+    import os
+
+    sys.path.insert(0, ".")
+
+    def run_level(level):
+        os.environ["PADDLE_TPU_OPT_LEVEL"] = str(level)
+        import paddle_tpu as fluid
+
+        with fluid.unique_name.guard():
+            with fluid.scope_guard(fluid.Scope()):
+                main_prog, startup = fluid.Program(), fluid.Program()
+                with fluid.program_guard(main_prog, startup):
+                    x = fluid.layers.data("x", shape=[64])
+                    y = fluid.layers.data("y", shape=[1], dtype="int64")
+                    h = fluid.layers.fc(x, size=64, act="relu")
+                    h = fluid.layers.dropout(
+                        h, 0.2, dropout_implementation="upscale_in_train")
+                    logits = fluid.layers.fc(h, size=10)
+                    loss = fluid.layers.mean(
+                        fluid.layers.softmax_with_cross_entropy(logits, y))
+                    # train-loop baggage the optimizer should shed when only
+                    # the loss is fetched: a metrics branch and a dead
+                    # constant chain (lr-schedule-style host arithmetic)
+                    fluid.layers.accuracy(fluid.layers.softmax(logits), y)
+                    c = fluid.layers.fill_constant([1], "float32", 2.0)
+                    fluid.layers.scale(c, scale=0.5)
+                    fluid.optimizer.Adam(1e-3).minimize(loss)
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                rng = np.random.RandomState(0)
+                feed = {"x": rng.randn(32, 64).astype("float32"),
+                        "y": rng.randint(0, 10, (32, 1)).astype("int64")}
+                t0 = time.perf_counter()
+                first, = exe.run(main_prog, feed=feed, fetch_list=[loss])
+                compile_ms = (time.perf_counter() - t0) * 1e3
+                losses = [first.copy()]
+                for _ in range(steps - 1):
+                    lv, = exe.run(main_prog, feed=feed, fetch_list=[loss])
+                    losses.append(lv.copy())
+                traced = exe._maybe_optimize(
+                    main_prog, (loss.name,), fluid.global_scope())
+                return (len(main_prog.global_block.ops),
+                        len(traced.global_block.ops), compile_ms, losses)
+
+    src0, traced0, ms0, losses0 = run_level(0)
+    src1, traced1, ms1, losses1 = run_level(1)
+    identical = all(np.array_equal(a, b)
+                    for a, b in zip(losses0, losses1))
+    print("opt_probe op_count    : src=%d  traced@0=%d  traced@1=%d"
+          % (src0, traced0, traced1))
+    print("opt_probe compile_ms  : level0=%.1f  level1=%.1f  (first step, "
+          "trace+XLA)" % (ms0, ms1))
+    print("opt_probe loss_parity : bit_identical=%s  (%d steps, dropout on)"
+          % (identical, len(losses0)))
+    ok = traced1 < traced0 and identical and ms1 <= ms0 * 1.05
+    print("opt_probe verdict     : %s" % ("OK" if ok else "FAIL"))
+    return 0 if ok else 1
 
 
 def main():
@@ -175,5 +245,7 @@ def main():
 if __name__ == "__main__":
     if "--host" in sys.argv:
         host_mode()
+    elif "--opt" in sys.argv:
+        sys.exit(opt_mode())
     else:
         main()

@@ -40,6 +40,7 @@ from . import (  # noqa: F401
     nets,
     optimizer,
     parallel,
+    passes,
     profiler,
     reader,
     regularizer,

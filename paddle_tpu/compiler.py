@@ -179,24 +179,48 @@ class CompiledProgram:
     def _apply_build_passes(self, scope):
         """Run the BuildStrategy's PassBuilder pipeline once, at first
         execution (the reference applies its pass pipeline when the
-        ParallelExecutor graph is built, build_strategy.cc:44-150)."""
+        ParallelExecutor graph is built, build_strategy.cc:44-150).
+
+        The DEFAULT optimizer pipeline (paddle_tpu.passes, gated by
+        ``PADDLE_TPU_OPT_LEVEL``) runs first on the same transactional
+        clone, so user pipelines compose AFTER the defaults, matching the
+        reference's BuildStrategy::CreatePassesFromStrategy ordering. With
+        no fetch info at build time only the FETCH-SAFE defaults run
+        (conv+bn folding, conservative DCE) — def-removing passes would
+        break later fetches of named intermediates; the Executor runs the
+        full fetch-seeded pipeline on the result (memoized; every default
+        pass is idempotent)."""
         if getattr(self, "_passes_applied", False):
             return
         bs = self._build_strategy
         builder = getattr(bs, "_pass_builder", None) if bs is not None else None
         if builder is None:
+            # no user pipeline: defaults are applied per fetch-set by the
+            # Executor (where DCE can seed liveness from real fetch targets)
             self._passes_applied = True
             return
         from .core.scope import global_scope
+        from .passes.pipeline import default_pipeline
 
+        scope = scope if scope is not None else global_scope()
         for p in builder.all_passes():
             if not p.has_attr("scope"):
-                p.set_attr("scope", scope if scope is not None else global_scope())
+                p.set_attr("scope", scope)
         # transactional: passes may mutate the program in place, so run the
-        # pipeline on a clone — a mid-pipeline failure leaves the original
-        # untouched and the retry starts from scratch instead of
-        # double-applying the passes that had already run
+        # pipeline on a clone — a mid-pipeline failure (PassError, naming
+        # the failing pass) leaves the original untouched and the retry
+        # starts from scratch instead of double-applying the passes that
+        # had already run
         work = self._program.clone()
+        # freeze stochastic ops' positional PRNG identity before any rewrite
+        # (see passes/analysis.py) — op deletion must not shift RNG streams
+        from .passes.analysis import stamp_rng_slots
+
+        work._rng_table_n = getattr(
+            self._program, "_rng_table_n",
+            len(self._program.global_block.ops) + 8)
+        stamp_rng_slots(work)
+        work = default_pipeline(scope=scope).apply_all(work)
         self._program = builder.apply_all(work)
         self._passes_applied = True
 

@@ -15,10 +15,24 @@ standalone via ``Pass.apply``.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, List, Optional, Union
 
-__all__ = ["Pass", "FunctionPass", "register_pass", "get_pass", "has_pass",
-           "registered_passes", "PassBuilder"]
+__all__ = ["Pass", "FunctionPass", "PassError", "register_pass", "get_pass",
+           "has_pass", "registered_passes", "PassBuilder"]
+
+
+class PassError(RuntimeError):
+    """A pass failed mid-pipeline. Carries ``pass_name`` so the
+    transactional-clone error path (``CompiledProgram._apply_build_passes``)
+    can say WHICH pass died instead of losing it in the traceback."""
+
+    def __init__(self, pass_name: str, original: BaseException):
+        self.pass_name = pass_name
+        self.original = original
+        super().__init__(
+            "pass %r failed: %s: %s"
+            % (pass_name, type(original).__name__, original))
 
 
 class Pass:
@@ -49,9 +63,16 @@ class Pass:
 
     # -- application ----------------------------------------------------------
     def apply(self, program):
+        from ..monitor import metrics as _mx
+
+        t0 = time.perf_counter() if _mx._enabled else 0.0
         out = self.apply_impl(program)
         program = out if out is not None else program
         program._version += 1  # invalidate executor program caches
+        if _mx._enabled:
+            _mx.histogram(
+                "passes/%s/time_ms" % (self.name or type(self).__name__)
+            ).observe((time.perf_counter() - t0) * 1e3)
         return program
 
     def apply_impl(self, program):
@@ -144,6 +165,15 @@ class PassBuilder:
         return list(self._passes)
 
     def apply_all(self, program):
+        """Apply every pass in order. A failing pass is re-raised as
+        :class:`PassError` naming it — callers running the pipeline on a
+        transactional clone (``CompiledProgram._apply_build_passes``) keep
+        the original program untouched AND know which pass to blame."""
         for p in self._passes:
-            program = p.apply(program)
+            try:
+                program = p.apply(program)
+            except PassError:
+                raise  # nested builders: keep the innermost attribution
+            except Exception as e:
+                raise PassError(p.name or type(p).__name__, e) from e
         return program

@@ -277,11 +277,18 @@ class TraceContext:
         self._n_ops = 0
 
     def op_rng(self, ctx: OpContext):
+        # RNG-stability contract (passes/analysis.py): an optimizer pass may
+        # delete or move ops, which would shift every later op's positional
+        # key. The pipeline stamps each stochastic op's ORIGINAL position
+        # into __rng_slot__ before mutating; honoring it here keeps the
+        # optimized program's RNG stream bit-identical to OPT_LEVEL=0.
+        idx = ctx.attr("__rng_slot__")
+        if idx is None:
+            idx = self.current_op_idx
         seed = ctx.attr("seed", 0) or self.program.random_seed
         if seed:
             # explicit per-op seed: a constant key XLA constant-folds
-            return jax.random.fold_in(jax.random.PRNGKey(seed),
-                                      self.current_op_idx)
+            return jax.random.fold_in(jax.random.PRNGKey(seed), idx)
         # Derive the main-block per-op keys with one batched split instead of
         # a scalar fold_in per RNG-consuming op: each scalar fold_in is ~113
         # unfusable scalar u32 entry instructions (a full threefry chain),
@@ -294,9 +301,13 @@ class TraceContext:
         # op the same key — so anything past the table keeps the scalar
         # fold_in (distinct key per index; those ops trace once inside the
         # loop body, so the scalar chains stay rare).
-        idx = self.current_op_idx
         if self._key_table is None:
-            self._n_ops = len(self.program.global_block.ops) + 8
+            # jax.random.split(key, n) keys depend on n, so an optimized
+            # program must build the table at the SOURCE program's size
+            # (_rng_table_n, stamped by the pipeline) for stamped slots to
+            # resolve to the same keys as the unoptimized program.
+            self._n_ops = getattr(self.program, "_rng_table_n",
+                                  len(self.program.global_block.ops) + 8)
             self._key_table = jax.random.split(self.base_rng, self._n_ops)
         if idx < self._n_ops:
             return self._key_table[idx]
@@ -729,6 +740,20 @@ class Executor:
             self._dev_resolved = True
         return self._dev
 
+    @staticmethod
+    def _maybe_optimize(program: Program, fetch_names, scope):
+        """Default trace-time optimizer (passes/, PADDLE_TPU_OPT_LEVEL,
+        default 1): returns the memoized optimized clone for this (program
+        version, fetch set) — the clone is what plan resolution and tracing
+        see, so the optimized program participates in the dispatch-plan and
+        compile-cache keys and a cache-hit run never re-enters a pass. The
+        per-step RNG counter stays on the SOURCE program (callers pass the
+        source to _next_step_index), keeping the RNG stream shared across
+        fetch-set variants exactly as at opt level 0."""
+        from .passes.pipeline import maybe_optimize
+
+        return maybe_optimize(program, fetch_names, scope)
+
     # -- the public API -------------------------------------------------------
     def run(
         self,
@@ -787,6 +812,12 @@ class Executor:
                     "feed all of them or none" % (fed, list(reader.var_names)))
         fetch_names = self._fetch_names(fetch_list)
 
+        # default trace-time optimizer: all bookkeeping below (plans, the
+        # specialization cache, tracing) keys on the optimized clone; only
+        # the step counter stays on the source program
+        src_program = program
+        program = self._maybe_optimize(program, fetch_names, scope)
+
         # hot-path guards read the module flags directly: with metrics and
         # tracing both off, the whole observability layer costs these two
         # attribute loads + branches per run — no lock, no allocation
@@ -798,7 +829,7 @@ class Executor:
             mx_on, tr_on, use_program_cache)
         compiled = plan.compiled
 
-        rng_key = self._next_step_index(program)
+        rng_key = self._next_step_index(src_program)
         state, feeds = self._place(plan, state, feeds, mesh)
         t_step = time.perf_counter() if mx_on else 0.0
         if tr_on:
@@ -1106,10 +1137,14 @@ class Executor:
             scope = global_scope()
         fetch_names = self._fetch_names(fetch_list)
         k = max(1, int(fetch_every))
+        # readers and the step counter stay bound to the source program; the
+        # optimized clone owns plans/specializations (same split as run())
+        src_program = program
+        program = self._maybe_optimize(program, fetch_names, scope)
 
         owned_prefetcher = None
         if feed_iter is None:
-            readers = [r for r in getattr(program, "_py_readers", ())
+            readers = [r for r in getattr(src_program, "_py_readers", ())
                        if r._started]
             if not readers:
                 raise ValueError(
@@ -1219,7 +1254,7 @@ class Executor:
                     state, _ = self._place(plan, state, {}, mesh)
 
                 n = len(chunk_feeds)
-                step_idx0 = self._next_step_index(program, n)
+                step_idx0 = self._next_step_index(src_program, n)
                 if n == 1:
                     _, stacked = self._place(plan, {}, chunk_feeds[0], mesh)
                     compiled = plan.compiled
@@ -1351,6 +1386,11 @@ class Executor:
         if scope is None:
             scope = global_scope()
         feed = dict(feed or {})
+        fetch_names = self._fetch_names(fetch_list)
+        # AOT-compile the OPTIMIZED program — the same object run() resolves,
+        # so the warmed specialization (and persistent-cache entry) is the
+        # one the real job hits
+        program = self._maybe_optimize(program, fetch_names, scope)
         block = program.global_block
         abstract = {}
         for name in sorted(feed):
@@ -1368,7 +1408,6 @@ class Executor:
             canonical = jax.dtypes.canonicalize_dtype(target)
             abstract[name] = jax.ShapeDtypeStruct(tuple(shape), canonical)
 
-        fetch_names = self._fetch_names(fetch_list)
         # the plan machinery accepts abstract feeds, so prepare() and a later
         # run() at the same shapes share one plan + specialization entry
         plan, _, state, _ = self._resolve_plan(

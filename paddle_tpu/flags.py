@@ -26,6 +26,15 @@ _DEFAULTS: Dict[str, Any] = {
                                      # S=8192; composed wins below (its single
                                      # fused HLO beats the kernel's fixed
                                      # grid overhead at short S)
+    "unfused_attention": False,      # layers.attention emits the reference-
+                                     # style primitive composition (matmul/
+                                     # scale/softmax/dropout/matmul) instead
+                                     # of the fused op for non-causal, non-
+                                     # segmented attention; the default
+                                     # optimizer's flash_attention_rewrite
+                                     # (PADDLE_TPU_OPT_LEVEL>=1) fuses it
+                                     # back — the graph stays inspectable,
+                                     # the kernel still gets hit
     "attention_softmax_f32": False,  # composed-attention softmax in f32:
                                      # +5 GB/step on Transformer-base (XLA
                                      # materializes the f32 probs for bwd);

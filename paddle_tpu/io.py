@@ -158,6 +158,17 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None, f
         scope.set_var(name, arr)
     if missing:
         raise RuntimeError("load_vars: missing from checkpoint: %s" % missing)
+    # A load swaps state under cached optimizations: passes that folded
+    # VALUES (conv+bn weight folding) baked the pre-load params into derived
+    # scope vars. Bumping the version invalidates the program's optimization
+    # + dispatch-plan caches so the next run re-derives from the fresh state.
+    # load_params/load_persistables default main_program=None but still load
+    # into default_main_program()'s vars — bump that one then. (Programs the
+    # bump can't reach — e.g. eval clones — are protected value-wise: the
+    # conv+bn fold records the scope objects it read and the optimizer memo
+    # re-validates them by identity, passes/pipeline._fold_sources_fresh.)
+    (main_program if main_program is not None
+     else default_main_program())._version += 1
 
 
 def load_params(executor, dirname, main_program=None, filename=None):

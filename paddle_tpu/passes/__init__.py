@@ -1,0 +1,44 @@
+"""paddle_tpu.passes — the default trace-time Program optimizer.
+
+Fluid's L4 layer is an IR pass framework (``framework/ir/``) that rewrites
+the program before execution; this package is its TPU-native counterpart
+over the Program IR (``core/framework.py``), run AUTOMATICALLY by the
+Executor at trace/prepare time (gated by ``PADDLE_TPU_OPT_LEVEL=0|1|2``,
+default 1):
+
+* :mod:`~paddle_tpu.passes.dce` — dead-op/dead-var elimination, liveness
+  seeded from fetch targets + persistables (eval programs shed train-only
+  ops).
+* :mod:`~paddle_tpu.passes.constant_fold` — host-evaluates ops whose
+  inputs are all compile-time constants (``fill_constant -> scale ->
+  elementwise_*`` chains collapse to one constant).
+* :mod:`~paddle_tpu.passes.cse` — common-subexpression elimination keyed
+  on (op type, value-numbered inputs, attrs).
+* :mod:`~paddle_tpu.passes.fuse_patterns` — rewrites XLA cannot do:
+  ``softmax``+``cross_entropy`` -> the fused loss op, and the unfused
+  QKV-matmul/scale/softmax/matmul attention composition -> the
+  flash-attention op.
+* ``conv_bn_fuse_pass`` (``transpiler/fuse_passes.py``) joins the default
+  pipeline for inference programs.
+
+A smaller program means faster tracing, smaller jaxprs, faster XLA
+compiles, better dispatch-plan / persistent-compile-cache hit rates, and
+more programs landing on the hand-tuned Pallas kernels. Each pass reports
+``passes/<name>/ops_removed`` / ``rewrites_matched`` counters and a
+``passes/<name>/time_ms`` histogram via :mod:`paddle_tpu.monitor`;
+inspect a program's before/after with ``python -m tools.dump_program``.
+"""
+
+from __future__ import annotations
+
+# importing the modules registers the passes
+from . import analysis, constant_fold, cse, dce, fuse_patterns  # noqa: F401
+from .pipeline import (  # noqa: F401
+    DEFAULT_PASS_NAMES, default_pipeline, maybe_optimize, opt_level,
+    optimize_program, pass_enabled,
+)
+
+__all__ = [
+    "DEFAULT_PASS_NAMES", "default_pipeline", "maybe_optimize", "opt_level",
+    "optimize_program", "pass_enabled",
+]

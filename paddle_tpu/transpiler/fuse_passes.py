@@ -11,6 +11,14 @@ is equally real on TPU (fewer HBM reads, one less kernel input).
 
 Only valid for inference programs (BN in global-stats mode): the pass
 requires the op to run with ``is_test``/``use_global_stats`` semantics.
+
+Folded values land in FRESH scope vars (``<w>.bn_fused``) and the conv is
+re-pointed at them; the original parameters are never overwritten. That
+makes the pass safe to re-apply from a clone of the original program over
+the same scope (the default optimizer pipeline does exactly that per fetch
+set) — a re-application recomputes the same fold from the same untouched
+inputs instead of compounding it. A second application to an already-fused
+program is a structural no-op (no ``batch_norm`` ops remain).
 """
 
 from __future__ import annotations
@@ -39,14 +47,35 @@ class ConvBNFusePass(Pass):
             raise ValueError(
                 "conv_bn_fuse_pass needs set_attr('scope', scope) — weight "
                 "folding reads/writes parameter values")
+        # shared graph maps (passes/analysis.py): one linear scan each
+        # instead of the old per-candidate O(n) rescan (O(n^2) over a deep
+        # resnet), rebuilt after each (rare) fuse — and use_counts also sees
+        # sub-block/attr readers, so a var a while-body consumes is never
+        # mistaken for single-consumer
+        from ..passes import analysis as A
+
         block = program.global_block
         ops = block.ops
+        uses = A.use_counts(program)
+        prod = A.producer_map(block)
 
-        def consumers(name, upto=None):
-            return [o for o in ops if any(
-                name in ns for ns in o.inputs.values())]
+        def _materialize_param(name, value):
+            """Materialize a folded value under a NEW deterministic name;
+            the original param is left untouched (re-apply safety)."""
+            if not block.has_var(name):
+                block.create_parameter(
+                    name=name, shape=[int(s) for s in value.shape],
+                    dtype=str(value.dtype), trainable=False, persistable=True)
+            scope.set_var(name, value)
+            return name
 
         fused = 0
+        replaced = []  # original param names the fuse may have orphaned
+        # scope objects the fold derived values from: maybe_optimize checks
+        # these by identity on every memo hit, so a checkpoint load or a
+        # train-step weight update (new array objects) forces a re-fold
+        # instead of silently serving stale fused weights
+        fold_sources = getattr(program, "_fold_sources", None) or {}
         i = 0
         while i < len(ops):
             bn = ops[i]
@@ -57,18 +86,18 @@ class ConvBNFusePass(Pass):
                 i += 1
                 continue
             x_name = bn.inputs["X"][0]
-            producer = next((o for o in ops if any(
-                x_name in ns for ns in o.outputs.values())), None)
-            if producer is None or len(consumers(x_name)) != 1:
+            producer = prod.get(x_name)
+            if producer is None or uses.get(x_name, 0) != 1:
                 i += 1
                 continue
             bias_op = None
             if producer.type == "elementwise_add":
                 bias_op = producer
                 conv_out = bias_op.inputs["X"][0]
-                conv = next((o for o in ops if o.type == "conv2d" and
-                             conv_out in o.outputs.get("Output", ())), None)
-                if conv is None or len(consumers(conv_out)) != 1:
+                conv = prod.get(conv_out)
+                if (conv is None or conv.type != "conv2d"
+                        or conv_out not in conv.outputs.get("Output", ())
+                        or uses.get(conv_out, 0) != 1):
                     i += 1
                     continue
                 # the add must be a per-channel BIAS, not a residual/shortcut
@@ -92,35 +121,46 @@ class ConvBNFusePass(Pass):
                 continue
 
             w_name = conv.inputs["Filter"][0]
-            vals = [scope.find_var(n) for n in (
-                bn.inputs["Scale"][0], bn.inputs["Bias"][0],
-                bn.inputs["Mean"][0], bn.inputs["Variance"][0], w_name)]
+            src_names = (bn.inputs["Scale"][0], bn.inputs["Bias"][0],
+                         bn.inputs["Mean"][0], bn.inputs["Variance"][0],
+                         w_name)
+            vals = [scope.find_var(n) for n in src_names]
             if any(v is None for v in vals):
                 # parameters not materialized (e.g. transpile before startup
                 # ran) — leave this candidate alone rather than crash
                 i += 1
                 continue
+            fold_sources.update(zip(src_names, vals))
             gamma, beta, mu, var, w = (np.asarray(v) for v in vals)
             eps = float(bn.attrs.get("epsilon", 1e-5))
             inv_std = gamma / np.sqrt(var + eps)
 
-            scope.set_var(w_name, (w * inv_std.reshape(-1, 1, 1, 1)).astype(w.dtype))
+            w_fused = _materialize_param(
+                w_name + ".bn_fused",
+                (w * inv_std.reshape(-1, 1, 1, 1)).astype(w.dtype))
+            conv.inputs["Filter"] = [w_fused]
+            replaced.append(w_name)
+            replaced.extend(bn.inputs[s][0]
+                            for s in ("Scale", "Bias", "Mean", "Variance"))
             bn_y = bn.outputs["Y"][0]
             if bias_op is not None:
                 b_name = bias_op.inputs["Y"][0]
-                b = np.asarray(scope.find_var(b_name))
-                scope.set_var(b_name,
-                              (beta + (b - mu) * inv_std).astype(b.dtype))
+                b_obj = scope.find_var(b_name)
+                fold_sources[b_name] = b_obj
+                b = np.asarray(b_obj)
+                b_fused = _materialize_param(
+                    b_name + ".bn_fused",
+                    (beta + (b - mu) * inv_std).astype(b.dtype))
+                bias_op.inputs["Y"] = [b_fused]
                 bias_op.outputs["Out"] = [bn_y]
+                replaced.append(b_name)
             else:
                 # conv had no bias: the folded β − μ·γ/√(σ²+ε) becomes one,
                 # written straight into the scope (inference programs don't
                 # re-run startup).
-                b_name = w_name + ".bn_fold_bias"
-                block.create_parameter(
-                    name=b_name, shape=[int(beta.shape[0])],
-                    dtype=str(beta.dtype), trainable=False, persistable=True)
-                scope.set_var(b_name, (beta - mu * inv_std).astype(beta.dtype))
+                b_name = _materialize_param(
+                    w_name + ".bn_fold_bias",
+                    (beta - mu * inv_std).astype(beta.dtype))
                 bias_var = block.var(b_name)
                 idx = ops.index(bn)
                 block.insert_op(
@@ -129,5 +169,21 @@ class ConvBNFusePass(Pass):
                     outputs={"Out": bn_y}, attrs={"axis": 1})
             block.remove_op(ops.index(bn))
             fused += 1
+            uses = A.use_counts(program)
+            prod = A.producer_map(block)
+
+        if fused:
+            program._fold_sources = fold_sources
+            # demote originals nothing reads anymore: they leave the
+            # persistable state set (no doubled conv weights in HBM) and
+            # dead-var elimination may then drop them from the symbol table.
+            # Scope values are untouched — a re-apply from a fresh clone of
+            # the ORIGINAL program still folds from pristine inputs.
+            all_uses = A.use_counts(program)
+            for name in replaced:
+                if all_uses.get(name, 0) == 0:
+                    v = block._find_var_recursive(name)
+                    if v is not None:
+                        v.persistable = False
         self.set_attr("fused_count", fused)
         return program

@@ -305,8 +305,14 @@ def test_close_clears_caches_and_counter_dies_with_program(rng):
     assert not exe._cache
     # no executor-held per-program dict left to leak (the old bug)
     assert not hasattr(exe, "_step_counters")
-    # plans + counters live on the Program -> freed with it
-    assert hasattr(main, "_dispatch_plans")
+    # plans + counters live on Program objects -> freed with them. Since the
+    # default trace-time optimizer (PADDLE_TPU_OPT_LEVEL>=1), plans attach
+    # to the optimized clone, which the SOURCE program owns via _opt_cache —
+    # the chain still dies with `main`.
+    optimized = exe._maybe_optimize(main, (loss.name,), fluid.global_scope())
+    assert hasattr(optimized, "_dispatch_plans")
+    if optimized is not main:
+        assert any(optimized is p for _, p in main._opt_cache[1].values())
 
 
 # -- prefetcher lifecycle -----------------------------------------------------

@@ -1,0 +1,625 @@
+"""Default trace-time optimizer (paddle_tpu.passes): DCE, constant folding,
+CSE, fused-kernel pattern rewrites, the PADDLE_TPU_OPT_LEVEL gates, and the
+Executor/CompiledProgram wiring (ISSUE 3).
+
+The load-bearing invariants:
+  * optimized programs are CLONES — the source program is never mutated;
+  * losses are bit-identical to PADDLE_TPU_OPT_LEVEL=0, dropout RNG
+    included (RNG-slot stamping, passes/analysis.py);
+  * re-running a pass on a cache hit is a bug — the optimization is
+    memoized per (program version, fetch set) and the dispatch-plan cache
+    keys on the optimized clone.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+from paddle_tpu.core.pass_framework import PassBuilder, PassError, get_pass
+from paddle_tpu.passes.pipeline import maybe_optimize, optimize_program
+
+
+def _count_ops(program, op_type):
+    return sum(1 for op in program.global_block.ops if op.type == op_type)
+
+
+def _op_types(program):
+    return [op.type for op in program.global_block.ops]
+
+
+def _counter(name):
+    snap = monitor.snapshot()
+    return snap.get(name, {}).get("value", 0.0)
+
+
+def _mlp_with_baggage(dropout=0.0):
+    """MLP whose program carries typical train-loop baggage: an unfetched
+    accuracy branch, a constant chain, and a duplicated subexpression."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[16])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=24, act="relu")
+        if dropout:
+            h = fluid.layers.dropout(
+                h, dropout, dropout_implementation="upscale_in_train")
+        logits = fluid.layers.fc(h, size=10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.layers.accuracy(fluid.layers.softmax(logits), y)
+        c = fluid.layers.fill_constant([1], "float32", 2.0)
+        fluid.layers.scale(c, scale=3.0)
+        a = fluid.layers.scale(h, scale=2.0)
+        b = fluid.layers.scale(h, scale=2.0)
+        fluid.layers.elementwise_add(a, b)
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    return main, startup, loss
+
+
+def _feed(rng, n=8):
+    return {"x": rng.randn(n, 16).astype("float32"),
+            "y": rng.randint(0, 10, (n, 1)).astype("int64")}
+
+
+# -- individual passes --------------------------------------------------------
+
+
+def test_dce_sheds_unfetched_branches_and_keeps_persistables(rng):
+    main, startup, loss = _mlp_with_baggage()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    opt = optimize_program(main, (loss.name,), fluid.global_scope())
+    # metrics branch, constant chain and duplicate subexpression all gone
+    assert _count_ops(opt, "accuracy") == 0
+    assert _count_ops(opt, "top_k") == 0
+    assert _count_ops(opt, "fill_constant") == 0
+    assert len(opt.global_block.ops) < len(main.global_block.ops)
+    # source untouched, params + optimizer state still persistable
+    assert _count_ops(main, "accuracy") == 1
+    src_persist = {v.name for v in main.list_vars() if v.persistable}
+    opt_persist = {v.name for v in opt.list_vars() if v.persistable}
+    assert src_persist == opt_persist
+
+
+def test_constant_folding_replaces_chain_with_single_constant(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        c = fluid.layers.fill_constant([4], "float32", 2.0)
+        c = fluid.layers.scale(c, scale=3.0, bias=1.0)
+        out = fluid.layers.elementwise_add(x, c)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = rng.randn(2, 4).astype("float32")
+    (want,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    opt = optimize_program(main, (out.name,), fluid.global_scope())
+    # chain collapsed: exactly one constant producer + the consumer add
+    assert _count_ops(opt, "scale") == 0
+    consts = (_count_ops(opt, "fill_constant")
+              + _count_ops(opt, "assign_value"))
+    assert consts == 1
+    (got,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    np.testing.assert_array_equal(want, got)
+    np.testing.assert_allclose(got, xs + 7.0, rtol=1e-6)
+
+
+def test_constant_folding_keeps_persistable_initializers(rng):
+    """Startup fill_constant writes a param — externally visible, must
+    survive folding (the executor flows it to the scope)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        fluid.layers.fc(x, size=3)
+    opt = optimize_program(startup, (), fluid.global_scope())
+    assert len(opt.global_block.ops) == len(startup.global_block.ops)
+
+
+def test_cse_merges_duplicate_subexpressions(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        a = fluid.layers.scale(x, scale=2.0)
+        b = fluid.layers.scale(x, scale=2.0)   # duplicate
+        c = fluid.layers.scale(x, scale=5.0)   # different attrs: kept
+        out = fluid.layers.elementwise_add(fluid.layers.elementwise_add(a, b), c)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = rng.randn(2, 4).astype("float32")
+    (want,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    opt = optimize_program(main, (out.name,), fluid.global_scope())
+    assert _count_ops(opt, "scale") == 2
+    (got,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    np.testing.assert_array_equal(want, got)
+    np.testing.assert_allclose(got, xs * 9.0, rtol=1e-6)
+
+
+def test_cse_alias_dies_on_redefinition(rng):
+    """A merged-away name that is later REDEFINED must stop aliasing:
+    downstream readers need the new definition, not the first occurrence."""
+    from paddle_tpu.passes.cse import CommonSubexpressionEliminationPass
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        a = fluid.layers.scale(x, scale=2.0)
+        b = fluid.layers.scale(x, scale=2.0)   # dup: aliased to a...
+        blk = main.global_block
+        blk.append_op("scale", inputs={"X": x}, outputs={"Out": b},
+                      attrs={"scale": 5.0, "bias": 0.0,
+                             "bias_after_scale": True})  # ...then redefined
+        c = fluid.layers.relu(b)
+        out = fluid.layers.elementwise_add(a, c)
+    CommonSubexpressionEliminationPass().apply(main)
+    relu = next(o for o in main.global_block.ops if o.type == "relu")
+    assert relu.inputs["X"] == [b.name]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (got,) = exe.run(main, feed={"x": np.ones((1, 4), "float32")},
+                     fetch_list=[out])
+    np.testing.assert_allclose(got, np.full((1, 4), 7.0))  # 2x + relu(5x)
+
+
+def test_build_time_pipeline_keeps_fetchable_leaves(rng):
+    """The CompiledProgram build path runs the pipeline with NO fetch info;
+    constant chains and duplicate leaves must stay fetchable at run time."""
+    from paddle_tpu.core.pass_framework import FunctionPass
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        loss = fluid.layers.mean(fluid.layers.fc(x, size=3))
+        d = fluid.layers.scale(
+            fluid.layers.fill_constant([1], "float32", 2.0), scale=0.5)
+    bs = fluid.compiler.BuildStrategy()
+    bs.pass_builder().append_pass(FunctionPass("noop", lambda p, s: None))
+    cp = fluid.compiler.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, build_strategy=bs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    lv, dv = exe.run(cp, feed={"x": np.ones((8, 4), "float32")},
+                     fetch_list=[loss, d])
+    assert float(np.asarray(dv).ravel()[0]) == pytest.approx(1.0)
+
+
+def test_cse_respects_redefinition(rng):
+    """An op whose output is clobbered between two identical computations
+    must NOT serve as the CSE source for the later one."""
+    from paddle_tpu.passes.cse import CommonSubexpressionEliminationPass
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        a = fluid.layers.scale(x, scale=2.0)
+        blk = main.global_block
+        # clobber a's var with a different value, then recompute scale(x, 2)
+        blk.append_op("scale", inputs={"X": x}, outputs={"Out": a},
+                      attrs={"scale": 7.0, "bias": 0.0,
+                             "bias_after_scale": True})
+        b = fluid.layers.scale(x, scale=2.0)
+        out = fluid.layers.elementwise_add(a, b)
+    p = CommonSubexpressionEliminationPass()
+    p.apply(main)
+    # the third scale cannot be merged into the (clobbered) first
+    assert _count_ops(main, "scale") == 3
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = rng.randn(2, 4).astype("float32")
+    (got,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    np.testing.assert_allclose(got, xs * 9.0, rtol=1e-6)
+
+
+# -- fused-kernel pattern rewrites --------------------------------------------
+
+
+def test_softmax_xent_fuse_rewrite_and_parity(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        logits = fluid.layers.fc(x, size=5)
+        probs = fluid.layers.softmax(logits)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(probs, y))
+        fluid.optimizer.SGD(0.5).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = _feed(rng)
+    feed["x"] = feed["x"][:, :8]
+    feed["y"] = np.clip(feed["y"], 0, 4)
+    opt = maybe_optimize(main, (loss.name,), fluid.global_scope())
+    assert _count_ops(opt, "softmax_with_cross_entropy") == 1
+    assert _count_ops(opt, "softmax") == 0
+    assert _count_ops(opt, "cross_entropy") == 0
+    # composed numerics at level 0 vs fused at level 1 agree closely (the
+    # fused op is the numerically superior formulation, not bit-equal)
+    losses = []
+    for _ in range(4):
+        lv, = exe.run(main, feed=feed, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0]  # trains through the fused custom-vjp
+
+
+def test_softmax_survives_when_probs_are_fetched(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        logits = fluid.layers.fc(x, size=5)
+        probs = fluid.layers.softmax(logits)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(probs, y))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    opt = maybe_optimize(main, (loss.name, probs.name), fluid.global_scope())
+    # the loss still fuses on the logits, but the fetched probs keep their op
+    assert _count_ops(opt, "softmax") == 1
+    assert _count_ops(opt, "softmax_with_cross_entropy") == 1
+    feed = {"x": rng.randn(4, 8).astype("float32"),
+            "y": rng.randint(0, 5, (4, 1)).astype("int64")}
+    lv, pv = exe.run(main, feed=feed, fetch_list=[loss, probs])
+    np.testing.assert_allclose(pv.sum(axis=-1), np.ones(4), rtol=1e-5)
+
+
+def _unfused_attention_program(dropout=0.0, with_bias=True):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        q = fluid.layers.data("q", shape=[2, 8, 4])
+        k = fluid.layers.data("k", shape=[2, 8, 4])
+        v = fluid.layers.data("v", shape=[2, 8, 4])
+        scores = fluid.layers.matmul(q, k, transpose_y=True, alpha=0.5)
+        if with_bias:
+            bias = fluid.layers.data("bias", shape=[2, 8, 8])
+            scores = fluid.layers.elementwise_add(scores, bias)
+        probs = fluid.layers.softmax(scores)
+        if dropout:
+            probs = fluid.layers.dropout(
+                probs, dropout, dropout_implementation="upscale_in_train")
+        out = fluid.layers.matmul(probs, v)
+        red = fluid.layers.mean(out)
+    return main, startup, red, probs
+
+
+@pytest.mark.parametrize("with_bias", [True, False])
+def test_flash_attention_rewrite_matches(rng, with_bias, monkeypatch):
+    main, startup, red, _ = _unfused_attention_program(with_bias=with_bias)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = {n: rng.randn(3, 2, 8, 4).astype("float32") for n in "qkv"}
+    if with_bias:
+        feed["bias"] = rng.randn(3, 2, 8, 8).astype("float32") * 0.1
+    monkeypatch.setenv("PADDLE_TPU_OPT_LEVEL", "0")
+    (want,) = exe.run(main, feed=feed, fetch_list=[red])
+    monkeypatch.setenv("PADDLE_TPU_OPT_LEVEL", "1")
+    opt = maybe_optimize(main, (red.name,), fluid.global_scope())
+    assert _count_ops(opt, "scaled_dot_product_attention") == 1
+    assert _count_ops(opt, "matmul") == 0
+    assert _count_ops(opt, "softmax") == 0
+    sdpa = next(o for o in opt.global_block.ops
+                if o.type == "scaled_dot_product_attention")
+    assert sdpa.attr("sm_scale") == pytest.approx(0.5)
+    assert bool(sdpa.inputs.get("Bias")) == with_bias
+    (got,) = exe.run(main, feed=feed, fetch_list=[red])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_flash_attention_rewrite_consumes_dropout(rng):
+    main, startup, red, _ = _unfused_attention_program(dropout=0.3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    opt = maybe_optimize(main, (red.name,), fluid.global_scope())
+    assert _count_ops(opt, "scaled_dot_product_attention") == 1
+    assert _count_ops(opt, "dropout") == 0
+    sdpa = next(o for o in opt.global_block.ops
+                if o.type == "scaled_dot_product_attention")
+    assert sdpa.attr("dropout_rate") == pytest.approx(0.3)
+    # the absorbed dropout's PRNG slot rides along (determinism across
+    # repeated optimizations of the same source program)
+    assert sdpa.attr("__rng_slot__") is not None
+
+
+def test_flash_attention_rewrite_skips_fetched_probs(rng):
+    main, startup, red, probs = _unfused_attention_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    opt = maybe_optimize(main, (red.name, probs.name), fluid.global_scope())
+    assert _count_ops(opt, "scaled_dot_product_attention") == 0
+    assert _count_ops(opt, "softmax") == 1
+
+
+def test_unfused_attention_flag_roundtrip(rng):
+    """FLAGS_unfused_attention emits primitives; the default pipeline fuses
+    them back; numerics match the directly-fused layer."""
+    from paddle_tpu.layers import attention as attn
+
+    def build(unfused):
+        main, startup = fluid.Program(), fluid.Program()
+        fluid.set_flag("unfused_attention", unfused)
+        try:
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data("x", shape=[8, 16])
+                out = attn.multi_head_attention(
+                    x, None, None, None, 4, 4, 16, 4, dropout_rate=0.0)
+                red = fluid.layers.mean(out)
+        finally:
+            fluid.set_flag("unfused_attention", False)
+        return main, startup, red
+
+    xs = rng.randn(2, 8, 16).astype("float32")
+    outs = {}
+    for unfused in (False, True):
+        with fluid.unique_name.guard():
+            with fluid.scope_guard(fluid.Scope()):
+                main, startup, red = build(unfused)
+                if unfused:
+                    assert _count_ops(main, "matmul") >= 2
+                    opt = maybe_optimize(main, (red.name,),
+                                         fluid.global_scope())
+                    assert _count_ops(opt, "scaled_dot_product_attention") == 1
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                (outs[unfused],) = exe.run(main, feed={"x": xs},
+                                           fetch_list=[red])
+    np.testing.assert_allclose(outs[True], outs[False], rtol=1e-5, atol=1e-6)
+
+
+# -- pipeline contract: idempotence, cache interaction, bit-identity ----------
+
+
+def _program_signature(program):
+    return [(op.type, sorted(op.inputs.items()), sorted(op.outputs.items()),
+             sorted((k, repr(v)) for k, v in op.attrs.items()))
+            for op in program.global_block.ops]
+
+
+def test_pipeline_idempotent_and_source_untouched(rng):
+    main, startup, loss = _mlp_with_baggage(dropout=0.2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    n_src = len(main.global_block.ops)
+    opt1 = optimize_program(main, (loss.name,), fluid.global_scope())
+    opt2 = optimize_program(opt1, (loss.name,), fluid.global_scope())
+    assert _program_signature(opt1) == _program_signature(opt2)
+    assert len(main.global_block.ops) == n_src
+
+
+def test_optimized_program_bit_identical_with_dropout(rng, monkeypatch):
+    """ISSUE 3 satellite: losses bit-identical to PADDLE_TPU_OPT_LEVEL=0,
+    dropout RNG included — even though DCE removes ops positioned BEFORE
+    the dropout op (the RNG-slot stamp keeps the key stream pinned)."""
+
+    def run_level(level):
+        monkeypatch.setenv("PADDLE_TPU_OPT_LEVEL", str(level))
+        with fluid.unique_name.guard():
+            with fluid.scope_guard(fluid.Scope()):
+                main, startup = fluid.Program(), fluid.Program()
+                with fluid.program_guard(main, startup):
+                    x = fluid.layers.data("x", shape=[16])
+                    y = fluid.layers.data("y", shape=[1], dtype="int64")
+                    # dead baggage BEFORE the dropout: removal shifts every
+                    # later op index unless slots are stamped
+                    c = fluid.layers.fill_constant([1], "float32", 2.0)
+                    fluid.layers.scale(c, scale=3.0)
+                    h = fluid.layers.fc(x, size=24, act="relu")
+                    h = fluid.layers.dropout(
+                        h, 0.4, dropout_implementation="upscale_in_train")
+                    logits = fluid.layers.fc(h, size=10)
+                    loss = fluid.layers.mean(
+                        fluid.layers.softmax_with_cross_entropy(logits, y))
+                    fluid.optimizer.Adam(1e-3).minimize(loss)
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                data = _feed(np.random.RandomState(7))
+                out = []
+                for _ in range(5):
+                    lv, = exe.run(main, feed=data, fetch_list=[loss])
+                    out.append(lv.copy())
+                if level:
+                    opt = exe._maybe_optimize(main, (loss.name,),
+                                              fluid.global_scope())
+                    assert len(opt.global_block.ops) < len(main.global_block.ops)
+                return out
+
+    l0 = run_level(0)
+    l1 = run_level(1)
+    for a, b in zip(l0, l1):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_optimization_memoized_and_plan_cache_hits(rng):
+    """Two runs reuse ONE optimized clone (re-running a pass on a cache hit
+    is a bug) and the second run is a dispatch-plan hit."""
+    main, startup, loss = _mlp_with_baggage()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feed = _feed(rng)
+    runs0 = _counter("passes/pipeline/runs")
+    exe.run(main, feed=feed, fetch_list=[loss])
+    opt_a = next(iter(main._opt_cache[1].values()))[1]
+    runs1 = _counter("passes/pipeline/runs")
+    hits_before = _counter("executor/plan_hit")
+    exe.run(main, feed=feed, fetch_list=[loss])
+    runs2 = _counter("passes/pipeline/runs")
+    hits_after = _counter("executor/plan_hit")
+    opt_b = next(iter(main._opt_cache[1].values()))[1]
+    assert opt_a is opt_b
+    if monitor.enabled():
+        assert runs1 > runs0          # first run paid one pipeline
+        assert runs2 == runs1         # second run re-entered NO pass
+        assert hits_after > hits_before
+
+
+def test_opt_level_zero_disables_everything(rng, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_OPT_LEVEL", "0")
+    main, startup, loss = _mlp_with_baggage()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    opt = exe._maybe_optimize(main, (loss.name,), fluid.global_scope())
+    assert opt is main
+
+
+def test_per_pass_env_gate(rng, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_PASS_DEAD_CODE_ELIMINATION", "0")
+    main, startup, loss = _mlp_with_baggage()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    opt = optimize_program(main, (loss.name,), fluid.global_scope())
+    # DCE off: the accuracy branch survives (CSE may still have merged the
+    # duplicate softmax feeding it)
+    assert _count_ops(opt, "accuracy") == 1
+    monkeypatch.delenv("PADDLE_TPU_PASS_DEAD_CODE_ELIMINATION")
+    opt2 = optimize_program(main, (loss.name,), fluid.global_scope())
+    assert _count_ops(opt2, "accuracy") == 0
+
+
+# -- PassBuilder error path (satellite) ---------------------------------------
+
+
+def test_apply_all_propagates_failing_pass_name():
+    from paddle_tpu.core.pass_framework import FunctionPass
+
+    def boom(program, p):
+        raise ValueError("kaboom")
+
+    builder = PassBuilder([FunctionPass("fine_pass", lambda prog, p: None),
+                           FunctionPass("exploding_pass", boom)])
+    with pytest.raises(PassError, match="exploding_pass"):
+        builder.apply_all(fluid.Program())
+
+
+def test_compiled_program_left_untouched_on_pass_failure(rng):
+    from paddle_tpu.core.pass_framework import FunctionPass, Pass
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        loss = fluid.layers.mean(fluid.layers.fc(x, size=3))
+
+    class Mutator(Pass):
+        name = "mutating_pass"
+
+        def apply_impl(self, program):
+            program.global_block.append_op(
+                "scale", inputs={"X": loss.name}, outputs={"Out": loss.name},
+                attrs={"scale": 1.0})
+
+    def boom(program, p):
+        raise RuntimeError("mid-pipeline failure")
+
+    bs = fluid.compiler.BuildStrategy()
+    bs.pass_builder().append_pass(Mutator())
+    bs.pass_builder().append_pass(FunctionPass("late_boom", boom))
+    compiled = fluid.compiler.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, build_strategy=bs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    n_ops = len(main.global_block.ops)
+    version = main._version
+    with pytest.raises(PassError, match="late_boom"):
+        exe.run(compiled, feed={"x": rng.randn(2, 4).astype("float32")},
+                fetch_list=[loss])
+    # transactional clone: the user's program is untouched by the half-run
+    # pipeline (the Mutator ran on the clone only)
+    assert len(main.global_block.ops) == n_ops
+    assert main._version == version
+
+
+# -- conv_bn_fuse_pass satellites ---------------------------------------------
+
+
+def _conv_bn_inference(rng, bias=True):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 8, 8])
+        c = fluid.layers.conv2d(img, num_filters=5, filter_size=3,
+                                bias_attr=None if bias else False)
+        out = fluid.layers.batch_norm(c, is_test=True)
+        out = fluid.layers.relu(out)
+    return main, startup, out
+
+
+def test_conv_bn_fuse_idempotent_second_apply_noop(rng):
+    main, startup, out = _conv_bn_inference(rng)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = fluid.global_scope()
+    p = get_pass("conv_bn_fuse_pass").set_attr("scope", scope)
+    p.apply(main)
+    assert p.attr("fused_count") == 1
+    sig = _program_signature(main)
+    p2 = get_pass("conv_bn_fuse_pass").set_attr("scope", scope)
+    p2.apply(main)
+    assert p2.attr("fused_count") == 0
+    assert _program_signature(main) == sig
+
+
+def test_conv_bn_fuse_reapply_from_original_is_safe(rng):
+    """The default pipeline re-clones the ORIGINAL program per fetch set;
+    folding must read pristine inputs each time, not compound."""
+    main, startup, out = _conv_bn_inference(rng)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    scope = fluid.global_scope()
+    for p in main.list_vars():
+        if p.name.endswith(".mean"):
+            scope.set_var(p.name, rng.randn(5).astype("float32") * 0.1)
+        if p.name.endswith(".var"):
+            scope.set_var(p.name, np.abs(rng.randn(5)).astype("float32") + 0.5)
+    main._version += 1  # stats changed under the cache
+    xs = rng.randn(2, 3, 8, 8).astype("float32")
+    clone_a = optimize_program(main, (out.name,), scope)
+    clone_b = optimize_program(main, (out.name,), scope)  # second fold
+    assert _count_ops(clone_a, "batch_norm") == 0
+    assert _count_ops(clone_b, "batch_norm") == 0
+    (got,) = exe.run(main, feed={"img": xs}, fetch_list=[out])
+    # reference: unfused numerics from a fresh un-optimized run
+    import os
+    prev = os.environ.get("PADDLE_TPU_OPT_LEVEL")
+    os.environ["PADDLE_TPU_OPT_LEVEL"] = "0"
+    try:
+        main._version += 1  # force past cached plans
+        (want,) = exe.run(main, feed={"img": xs}, fetch_list=[out])
+    finally:
+        if prev is None:
+            os.environ.pop("PADDLE_TPU_OPT_LEVEL", None)
+        else:
+            os.environ["PADDLE_TPU_OPT_LEVEL"] = prev
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_conv_bn_fused_by_default_inference_pipeline(rng):
+    """Satellite: the fuse pass is part of the default opt-level>=1 pipeline
+    for is_test programs — no BuildStrategy wiring needed."""
+    main, startup, out = _conv_bn_inference(rng)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xs = rng.randn(2, 3, 8, 8).astype("float32")
+    before = _counter("passes/conv_bn_fuse_pass/rewrites_matched")
+    (got,) = exe.run(main, feed={"img": xs}, fetch_list=[out])
+    opt = exe._maybe_optimize(main, (out.name,), fluid.global_scope())
+    assert _count_ops(opt, "batch_norm") == 0
+    assert _count_ops(main, "batch_norm") == 1  # source untouched
+    if monitor.enabled():
+        assert _counter("passes/conv_bn_fuse_pass/rewrites_matched") > before
+    # numerics match the unfused program
+    import os
+    os.environ["PADDLE_TPU_OPT_LEVEL"] = "0"
+    try:
+        main._version += 1
+        (want,) = exe.run(main, feed={"img": xs}, fetch_list=[out])
+    finally:
+        os.environ.pop("PADDLE_TPU_OPT_LEVEL", None)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+# -- tooling ------------------------------------------------------------------
+
+
+def test_dump_program_selftest_runs():
+    import subprocess
+    import sys as _sys
+
+    r = subprocess.run(
+        [_sys.executable, "-m", "tools.dump_program", "--selftest"],
+        capture_output=True, text=True, timeout=120,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout

@@ -1,0 +1,187 @@
+"""Inspect a Program before/after the default trace-time optimizer.
+
+The graph-pass twin of tools/dump_metrics.py:
+
+    python -m tools.dump_program
+        Print the canned demo program's op list (the same MLP-with-baggage
+        probe benchmarks/diag_overhead.py --opt uses).
+
+    python -m tools.dump_program --diff
+        Run the default pipeline (PADDLE_TPU_OPT_LEVEL, default 1) pass by
+        pass over the demo program and print, for each pass, the op-list
+        delta it is responsible for — per-pass attribution of every removed,
+        inserted, and rewritten op.
+
+    python -m tools.dump_program --diff --model DIR
+        Same, over a saved inference model (io.load_inference_model) instead
+        of the canned demo.
+
+    python -m tools.dump_program --selftest
+        Assert the canned MLP program shrinks under the default pipeline
+        (<2s, JAX_PLATFORMS=cpu) and exit 0/1 — a CI smoke gate alongside
+        ``tools/dump_metrics --selftest``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from collections import Counter
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def format_ops(program, prefix="  "):
+    lines = []
+    for i, op in enumerate(program.global_block.ops):
+        ins = sorted(set(op.input_arg_names))
+        outs = sorted(set(op.output_arg_names))
+        lines.append("%s%3d: %-28s (%s) -> (%s)"
+                     % (prefix, i, op.type, ", ".join(ins), ", ".join(outs)))
+    return "\n".join(lines)
+
+
+def _demo_program(fluid):
+    """Canned MLP with typical optimizer fodder: an unfetched metrics
+    branch (DCE), a constant chain (folding), a duplicated subexpression
+    (CSE) and a primitive softmax+cross_entropy composition (pattern
+    rewrite)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[32])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=32, act="relu")
+        logits = fluid.layers.fc(h, size=10)
+        probs = fluid.layers.softmax(logits)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(probs, y))
+        fluid.layers.accuracy(fluid.layers.softmax(logits), y)  # dead branch
+        c = fluid.layers.fill_constant([1], "float32", 4.0)
+        c = fluid.layers.scale(c, scale=0.25)                   # folds to 1.0
+        dup_a = fluid.layers.scale(h, scale=2.0)                # CSE pair...
+        dup_b = fluid.layers.scale(h, scale=2.0)
+        fluid.layers.elementwise_add(dup_a, dup_b)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _diff_counts(before_ops, after_ops):
+    b, a = Counter(o.type for o in before_ops), Counter(o.type for o in after_ops)
+    removed = {t: n for t, n in (b - a).items()}
+    added = {t: n for t, n in (a - b).items()}
+    return removed, added
+
+
+def run_diff(program, scope, fetch_names, fluid) -> int:
+    from paddle_tpu.core.pass_framework import get_pass
+    from paddle_tpu.passes import analysis as A
+    from paddle_tpu.passes.pipeline import (DEFAULT_PASS_NAMES, opt_level,
+                                            pass_enabled)
+
+    level = opt_level()
+    print("PADDLE_TPU_OPT_LEVEL=%d" % level)
+    print("before (%d ops):" % len(program.global_block.ops))
+    print(format_ops(program))
+    if level <= 0:
+        print("\nopt level 0: pipeline disabled, nothing to diff")
+        return 0
+
+    work = program.clone()
+    work._rng_table_n = getattr(program, "_rng_table_n",
+                                len(program.global_block.ops) + 8)
+    A.stamp_rng_slots(work)
+    protected = A.protected_names(work, fetch_names)
+    for name in DEFAULT_PASS_NAMES:
+        if not pass_enabled(name):
+            print("\n== %s: disabled via env gate" % name)
+            continue
+        if name == "conv_bn_fuse_pass" and scope is None:
+            print("\n== %s: skipped (no scope)" % name)
+            continue
+        p = get_pass(name)
+        p.set_attr("scope", scope)
+        p.set_attr("fetch_names", tuple(fetch_names))
+        p.set_attr("protected", set(protected))
+        n_before = len(work.global_block.ops)
+        before_ops = list(work.global_block.ops)
+        work = p.apply(work)
+        removed, added = _diff_counts(before_ops, work.global_block.ops)
+        delta = len(work.global_block.ops) - n_before
+        print("\n== %s: %d -> %d ops (%+d)"
+              % (name, n_before, len(work.global_block.ops), delta))
+        for t, n in sorted(removed.items()):
+            print("   - %dx %s" % (n, t))
+        for t, n in sorted(added.items()):
+            print("   + %dx %s" % (n, t))
+        if not removed and not added:
+            print("   (no-op)")
+    print("\nafter (%d ops):" % len(work.global_block.ops))
+    print(format_ops(work))
+    return 0
+
+
+def selftest() -> int:
+    os.environ.setdefault("PADDLE_TPU_OPT_LEVEL", "1")
+    import paddle_tpu as fluid
+    from paddle_tpu.passes.pipeline import optimize_program
+
+    with fluid.unique_name.guard():
+        with fluid.scope_guard(fluid.Scope()):
+            main, startup, loss = _demo_program(fluid)
+            n_before = len(main.global_block.ops)
+            opt = optimize_program(main, (loss.name,), fluid.global_scope())
+            n_after = len(opt.global_block.ops)
+            assert n_after < n_before, \
+                "pipeline failed to shrink the canned MLP (%d -> %d)" % (
+                    n_before, n_after)
+            # the pipeline must be idempotent: a second application of the
+            # default passes to its own output changes nothing
+            opt2 = optimize_program(opt, (loss.name,), fluid.global_scope())
+            sig = [(o.type, sorted(o.input_arg_names),
+                    sorted(o.output_arg_names)) for o in opt.global_block.ops]
+            sig2 = [(o.type, sorted(o.input_arg_names),
+                     sorted(o.output_arg_names)) for o in opt2.global_block.ops]
+            assert sig == sig2, "default pipeline is not idempotent"
+            # source program untouched
+            assert len(main.global_block.ops) == n_before
+    print("dump_program selftest: OK (%d -> %d ops, idempotent)"
+          % (n_before, n_after))
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--selftest" in argv:
+        return selftest()
+
+    import paddle_tpu as fluid
+
+    model_dir = None
+    if "--model" in argv:
+        model_dir = argv[argv.index("--model") + 1]
+    with fluid.unique_name.guard():
+        with fluid.scope_guard(fluid.Scope()):
+            if model_dir:
+                exe = fluid.Executor(fluid.CPUPlace())
+                program, feed_names, fetched = fluid.io.load_inference_model(
+                    model_dir, exe)
+                fetch_names = tuple(
+                    f.name if hasattr(f, "name") else str(f) for f in fetched)
+            else:
+                program, startup, loss = _demo_program(fluid)
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                fetch_names = (loss.name,)
+            if "--diff" in argv:
+                return run_diff(program, fluid.global_scope(), fetch_names,
+                                fluid)
+            print("%d ops:" % len(program.global_block.ops))
+            print(format_ops(program))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

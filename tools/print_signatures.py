@@ -38,6 +38,7 @@ MODULES = [
     "paddle_tpu.amp",
     "paddle_tpu.imperative",
     "paddle_tpu.parallel",
+    "paddle_tpu.passes",
     "paddle_tpu.profiler",
     "paddle_tpu.transpiler",
     "paddle_tpu.contrib",
