@@ -916,7 +916,8 @@ def bench_longseq_train(batch=8, seq=2048, vocab=32000, skip=3, iters=10,
 
 
 def bench_deepfm(batch=1024, vocab=int(1e6), num_fields=26, emb_dim=10,
-                 is_sparse=True, skip=5, iters=20, _diag=None):
+                 is_sparse=True, skip=5, iters=20, _diag=None,
+                 shard_axes=None):
     """``is_sparse=True`` is the SelectedRows-equivalent rows-only path
     (V-independent step cost); ``False`` is the dense gather+scatter path
     (faster at small V/batch where the sparse machinery's fixed cost isn't
@@ -935,15 +936,29 @@ def bench_deepfm(batch=1024, vocab=int(1e6), num_fields=26, emb_dim=10,
                 ids = fluid.layers.data("ids", shape=[num_fields], dtype="int64")
                 dense = fluid.layers.data("dense", shape=[13])
                 label = fluid.layers.data("label", shape=[1], dtype="int64")
-                _, loss, _ = dfm.deepfm(ids, dense, label,
-                                        sparse_feature_dim=vocab,
-                                        embedding_size=emb_dim,
-                                        num_fields=num_fields,
-                                        is_sparse=is_sparse)
+                _, loss, _ = dfm.deepfm(
+                    ids, dense, label,
+                    sparse_feature_dim=vocab,
+                    embedding_size=emb_dim,
+                    num_fields=num_fields,
+                    is_sparse=is_sparse,
+                    sharding_axis="model" if shard_axes else None)
                 fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
 
             exe = fluid.Executor(fluid.TPUPlace(0))
-            exe.run(startup)
+            if shard_axes:
+                # tables + Adam moments row-sharded over ``model``; the
+                # startup init materializes them shard-by-shard (V=1e8
+                # single-chip init RESOURCE_EXHAUSTs — BENCH_r05)
+                from paddle_tpu import parallel
+
+                mesh = parallel.create_mesh(dict(shard_axes))
+                with parallel.mesh_guard(mesh):
+                    exe.run(startup)
+                main_prog = fluid.CompiledProgram(main_prog).with_mesh(
+                    dict(shard_axes), loss_name=loss.name)
+            else:
+                exe.run(startup)
             rng = np.random.RandomState(0)
             feed = _device_feed({
                 "ids": rng.randint(0, vocab, (batch, num_fields)).astype("int64"),
@@ -1392,6 +1407,12 @@ def main():
             # p+m+v in either mode (the sharded-embedding multi-chip path
             # is the capacity story there). benchmarks/SPARSE_PROFILE.md.
             sweep = {}
+            from paddle_tpu.ops.optimizer_ops import _sparse_kernel_mode
+
+            # which sparse-update implementation this sweep measured: the
+            # row-DMA Pallas kernel (pallas_kernels/sparse_adam.py, auto on
+            # TPU via FLAGS_sparse_update_kernel) or the XLA scatter path
+            sweep["update_impl"] = _sparse_kernel_mode() or "xla_scatter"
             for vv in (int(1e6), int(1e7), int(5e7), int(1e8)):
                 ent = {}
                 import gc
@@ -1411,6 +1432,25 @@ def main():
                     ent["sparse_over_dense"] = round(
                         ent["dense_eps"] / ent["sparse_eps"], 4)
                 sweep["V=%.0e" % vv] = ent
+            import gc
+
+            gc.collect()
+            import jax as _jax
+
+            if len(_jax.devices()) >= 2:
+                # the capacity leg: V=1e8 runs ONLY with the table (and its
+                # Adam moments) row-sharded over the mesh — 13.2 GB of CTR
+                # state at ~1.65 GB/chip on 8 devices
+                nd = len(_jax.devices())
+                try:
+                    e_, _ = bench_deepfm(
+                        vocab=int(1e8), is_sparse=True, skip=2, iters=5,
+                        shard_axes={"data": 1, "model": nd})
+                    sweep["V=1e+08_sharded_model=%d" % nd] = {
+                        "sparse_eps": round(e_, 2)}
+                except Exception as ex:
+                    sweep["V=1e+08_sharded_model=%d" % nd] = {
+                        "error": repr(ex)[:120]}
             detail["deepfm_v_sweep"] = sweep
         except Exception as e:
             detail["deepfm_v_sweep"] = {"error": repr(e)[:200]}
@@ -1427,7 +1467,43 @@ def main():
         "detail": detail,
         "metrics": _monitor_metrics_section(),
     }))
+    # the compact per-config digest is the LAST line on purpose: a log tail
+    # (drivers keep ~2,000 chars) always carries the headline numbers even
+    # when the full detail JSON above is truncated (VERDICT "do this" #5)
+    print(json.dumps({"summary": _compact_summary(detail)}))
     return 0
+
+
+def _compact_summary(detail):
+    """{config: {eps_median, mfu, overhead}} — one short row per benched
+    config, plus the deepfm sweep's sparse_over_dense ratios."""
+    out = {}
+    for name, ent in detail.items():
+        if not isinstance(ent, dict):
+            continue
+        if "examples_per_sec" not in ent:
+            if "error" in ent:
+                out[name] = {"error": str(ent["error"])[:60]}
+            continue
+        row = {"eps_median": ent["examples_per_sec"]}
+        if "mfu_est" in ent:
+            row["mfu"] = ent["mfu_est"]
+        if "overhead_vs_raw_jax" in ent:
+            row["overhead"] = ent["overhead_vs_raw_jax"]
+        out[name] = row
+    sweep = detail.get("deepfm_v_sweep")
+    if isinstance(sweep, dict) and "error" not in sweep:
+        row = {}
+        for k, ent in sweep.items():
+            if isinstance(ent, dict) and ent.get("sparse_over_dense"):
+                row[k] = ent["sparse_over_dense"]
+            elif isinstance(ent, dict) and "sharded" in k:
+                row[k] = ent.get("sparse_eps") or str(
+                    ent.get("error", ""))[:40]
+        if "update_impl" in sweep:
+            row["update_impl"] = sweep["update_impl"]
+        out["deepfm_sparse_over_dense"] = row
+    return out
 
 
 def _graph_opt_section():

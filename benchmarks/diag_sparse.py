@@ -1,0 +1,107 @@
+"""Sparse-update implementation probe: XLA scatter path vs the Pallas
+row-DMA kernel on the DeepFM-shape [V, 10] table.
+
+The three scatter fusions are the whole sparse-over-dense gap at V=1e6
+(SPARSE_PROFILE.md §1: ~30 GB/s effective, one VMEM-resident table out of
+three). This probe times ONE sparse-Adam update — gather + row math +
+writeback over merged (ids, rows) — both ways, isolated from the rest of
+the DeepFM step.
+
+    python benchmarks/diag_sparse.py                # [1e6, 10], 26624 ids
+    python benchmarks/diag_sparse.py --vocab 1e7
+
+On TPU the kernel path is the compiled Mosaic kernel and the numbers are
+the real before/after for SPARSE_PROFILE.md §4. On CPU the kernel runs in
+the Pallas *interpreter* — a correctness vehicle, orders of magnitude slow
+— so the probe shrinks the id count and labels the result cpu-interpret;
+only the scatter number is meaningful there.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")  # run from the repo root, like the other diags
+
+
+def _timeit(fn, iters=20, skip=3):
+    for _ in range(skip):
+        jax.block_until_ready(fn())
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    vocab = int(1e6)
+    n_ids = 26624  # b1024 × 26 fields
+    for i, a in enumerate(sys.argv):
+        if a == "--vocab":
+            vocab = int(float(sys.argv[i + 1]))
+        if a == "--ids":
+            n_ids = int(sys.argv[i + 1])
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:
+        n_ids = min(n_ids, 512)  # interpret mode: keep the probe finite
+
+    from paddle_tpu.core.sparse import merge_rows
+    from paddle_tpu.ops.pallas_kernels.sparse_adam import sparse_adam_rows
+
+    rng = np.random.RandomState(0)
+    dim = 10
+    ids = jnp.asarray(rng.randint(0, vocab, (n_ids,)).astype(np.int32))
+    raw = jnp.asarray(rng.randn(n_ids, dim).astype(np.float32))
+    p = jnp.asarray(rng.randn(vocab, dim).astype(np.float32))
+    m = jnp.zeros((vocab, dim), jnp.float32)
+    v = jnp.zeros((vocab, dim), jnp.float32)
+    b1, b2, eps, lr_t = 0.9, 0.999, 1e-8, 1e-3
+
+    @jax.jit
+    def scatter_update(p, m, v, ids, raw):
+        uniq, merged = merge_rows(ids, raw, vocab)
+        m_rows = b1 * m[uniq] + (1 - b1) * merged
+        v_rows = b2 * v[uniq] + (1 - b2) * jnp.square(merged)
+        step = lr_t * m_rows / (jnp.sqrt(v_rows) + eps)
+        return (p.at[uniq].add(-step),
+                m.at[uniq].add(m_rows - m[uniq]),
+                v.at[uniq].add(v_rows - v[uniq]))
+
+    @jax.jit
+    def kernel_update(p, m, v, ids, raw):
+        uniq, merged = merge_rows(ids, raw, vocab)
+        return sparse_adam_rows(p, m, v, uniq, merged, lr_t, b1, b2, eps,
+                                interpret=not on_tpu)
+
+    scatter_ms = _timeit(lambda: scatter_update(p, m, v, ids, raw))
+    kernel_ms = _timeit(lambda: kernel_update(p, m, v, ids, raw),
+                        iters=20 if on_tpu else 3, skip=3 if on_tpu else 1)
+
+    a, b = scatter_update(p, m, v, ids, raw), kernel_update(p, m, v, ids, raw)
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=1e-5, atol=1e-6)
+
+    print(json.dumps({
+        "mode": "tpu" if on_tpu else "cpu-interpret",
+        "vocab": vocab, "n_ids": n_ids,
+        "scatter_update_ms": round(scatter_ms, 3),
+        "kernel_update_ms": round(kernel_ms, 3),
+        "kernel_over_scatter": round(kernel_ms / scatter_ms, 3),
+        "note": ("kernel compiled (Mosaic); numbers are the SPARSE_PROFILE "
+                 "§4 before/after" if on_tpu else
+                 "kernel INTERPRETED on CPU — parity only, timing not "
+                 "meaningful; run on TPU for the real comparison"),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

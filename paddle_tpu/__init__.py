@@ -23,6 +23,22 @@ from . import compile_cache as _compile_cache  # noqa: F401
 
 _compile_cache.setup_compile_cache()
 
+# Sharding-invariant RNG: with the legacy threefry lowering, random values
+# change when XLA partitions the generating computation — which would make a
+# mesh-sharded table's shard-by-shard init (ops/tensor_ops._run_init) and a
+# data-parallel dropout mask diverge from their single-device twins. The
+# partitionable lowering keeps every random stream bit-identical no matter
+# how GSPMD splits it (and is what later JAX releases default to), so loss
+# parity between single-device and mesh runs includes the RNG. An explicit
+# JAX_THREEFRY_PARTITIONABLE env setting wins — a host app pinning the
+# legacy streams keeps them (and forfeits mesh/single-device RNG parity).
+import os as _os
+
+if "JAX_THREEFRY_PARTITIONABLE" not in _os.environ:
+    import jax as _jax
+
+    _jax.config.update("jax_threefry_partitionable", True)
+
 from . import (  # noqa: F401
     amp,
     backward,

@@ -34,6 +34,41 @@ def _var_desc(name, env, block):
     return name + "[?]"
 
 
+def _oom_hint(e: BaseException, op) -> str:
+    """Actionable RESOURCE_EXHAUSTED diagnosis: report the bytes the op
+    asked for and point at the CTR-scale escape hatches instead of leaving
+    a raw XLA allocator traceback (the BENCH_r05 V=1e8 failure mode —
+    a [1e8, D] fill_constant/parameter init exhausting one chip's HBM
+    at trace time)."""
+    txt = "%s: %s" % (type(e).__name__, e)
+    if ("RESOURCE_EXHAUSTED" not in txt and "RESOURCE EXHAUSTED" not in txt
+            and "out of memory" not in txt.lower()):
+        return ""
+    detail = ""
+    shape = op.attrs.get("shape")
+    if shape:
+        try:
+            import numpy as np
+
+            from .dtypes import to_jnp_dtype
+
+            n = int(np.prod([int(s) for s in shape]))
+            itemsize = np.dtype(
+                to_jnp_dtype(op.attrs.get("dtype", "float32"))).itemsize
+            detail = " (requested %s = %d elements, %.2f GB)" % (
+                list(shape), n, n * itemsize / 1e9)
+        except Exception:
+            pass
+    return (
+        "\n  hint: device memory exhausted allocating this op's output%s. "
+        "For CTR-scale embedding tables: layers.embedding(..., "
+        "is_sparse=True) keeps gradients + optimizer updates rows-only, and "
+        "parallel.sharded_embedding(..., mesh_axis=...) row-shards the "
+        "table AND its Adam moments over a device mesh (V/n rows per "
+        "device, initialized shard-by-shard) — see README \"Sparse & CTR\"."
+        % detail)
+
+
 def wrap_op_error(e: BaseException, op, op_index: int, env=None) -> EnforceNotMet:
     """Build the enriched error for an op impl failure during tracing."""
     block = getattr(op, "block", None)
@@ -45,9 +80,9 @@ def wrap_op_error(e: BaseException, op, op_index: int, env=None) -> EnforceNotMe
         "  %s: %s\n"
         "  inputs:  %s\n"
         "  outputs: %s\n"
-        "  attrs:   %s\n"
+        "  attrs:   %s%s\n"
         "(reference parity: PADDLE_ENFORCE context, platform/enforce.h)"
         % (op.type, op_index, type(e).__name__, e, ins, outs,
-           dict(op.attrs))
+           dict(op.attrs), _oom_hint(e, op))
     )
     return EnforceNotMet(msg)

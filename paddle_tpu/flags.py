@@ -43,6 +43,22 @@ _DEFAULTS: Dict[str, Any] = {
                                      # which the per-block compute switches
                                      # from composed to the Pallas flash
                                      # kernel (same crossover as above)
+    "sparse_update_kernel": "auto",  # row-wise Pallas sparse-Adam/SGD kernel
+                                     # (pallas_kernels/sparse_adam.py) instead
+                                     # of the 3 XLA scatter fusions on
+                                     # SelectedRows updates: "auto" = compiled
+                                     # kernel on TPU, scatter elsewhere;
+                                     # "on" = kernel everywhere (interpreted
+                                     # off-TPU); "interpret" = force the
+                                     # interpreter (parity tests); "off" =
+                                     # always scatter
+    "ctr_alltoall_update": False,    # sharded-table sparse updates route
+                                     # (ids, rows) to owner shards with an
+                                     # explicit lax.all_to_all (PS split_ids
+                                     # parity) instead of replicating the
+                                     # merged rows to every model shard;
+                                     # exact (worst-case bucket capacity),
+                                     # see benchmarks/COLLECTIVES.md §7
     "eager_delete_tensor_gb": 0.0,   # accepted; XLA buffer liveness handles it
     # accepted for compatibility, no-ops under XLA
     "fraction_of_gpu_memory_to_use": 0.92,

@@ -24,26 +24,45 @@ def deepfm(
     layer_sizes=(400, 400, 400),
     is_test=False,
     is_sparse=True,
+    sharding_axis=None,
 ):
     """sparse_ids: [batch, num_fields] int64 (global hashed ids);
     dense_feat: [batch, dense_dim] float32; label: [batch, 1] int64.
     Returns (predict_probs, avg_loss, auc_var).
+
+    ``sharding_axis`` (e.g. ``"model"``) row-shards both embedding tables
+    (and their Adam moments) over that mesh axis via
+    ``parallel.sharded_embedding`` — the V=1e8 capacity path: ~V/n rows per
+    device, table initialized shard-by-shard, optimizer updates shard-local
+    rows-only. Run the program through ``CompiledProgram.with_mesh`` with a
+    mesh carrying the axis.
     """
     init = layers.ParamAttr(
         name="sparse_emb",
         initializer=init_mod.TruncatedNormal(0.0, 1.0 / (embedding_size ** 0.5)),
     )
+    w1_attr = layers.ParamAttr(
+        name="sparse_w1",
+        initializer=init_mod.TruncatedNormal(0.0, 1e-4))
     # [b, f, e] factor embeddings + [b, f, 1] first-order weights
     # is_sparse=True: SelectedRows-equivalent rows-only gradients + lazy
     # optimizer updates (reference dist_ctr.py uses is_sparse=True too) —
     # the step cost must stay independent of sparse_feature_dim
-    emb = layers.embedding(sparse_ids, size=[sparse_feature_dim, embedding_size],
-                           param_attr=init, is_sparse=is_sparse)
-    w1 = layers.embedding(sparse_ids, size=[sparse_feature_dim, 1],
-                          param_attr=layers.ParamAttr(
-                              name="sparse_w1",
-                              initializer=init_mod.TruncatedNormal(0.0, 1e-4)),
-                          is_sparse=is_sparse)
+    if sharding_axis:
+        from .. import parallel
+
+        emb = parallel.sharded_embedding(
+            sparse_ids, size=[sparse_feature_dim, embedding_size],
+            mesh_axis=sharding_axis, param_attr=init, is_sparse=is_sparse)
+        w1 = parallel.sharded_embedding(
+            sparse_ids, size=[sparse_feature_dim, 1],
+            mesh_axis=sharding_axis, param_attr=w1_attr, is_sparse=is_sparse)
+    else:
+        emb = layers.embedding(sparse_ids,
+                               size=[sparse_feature_dim, embedding_size],
+                               param_attr=init, is_sparse=is_sparse)
+        w1 = layers.embedding(sparse_ids, size=[sparse_feature_dim, 1],
+                              param_attr=w1_attr, is_sparse=is_sparse)
 
     # FM first order
     first_order = layers.reduce_sum(w1, dim=1)  # [b, 1]

@@ -696,16 +696,26 @@ def lookup_table_op(ctx: OpContext):
                 "sparse embedding table %r is looked up more than once in one "
                 "program — use is_sparse=False for shared tables" % w_name)
         collect[w_name] = ((int(np.prod(ids.shape)), d), w.dtype)
+    # clamp BOTH ends for the gather: jnp.take's single-device default
+    # clips, but a row-sharded table turns the gather into per-shard gathers
+    # where XLA's out-of-bounds semantics are undefined (garbage/NaN) —
+    # explicit clipping keeps mesh and single-device behavior identical for
+    # stray ids
     virtuals = env.get("__sparse_virtual__") or {}
     if w_name in virtuals:
-        flat_ids = ids.reshape(-1)
-        gathered = jnp.take(jax.lax.stop_gradient(w),
-                            jnp.maximum(flat_ids, 0), axis=0)
+        flat_raw = ids.reshape(-1)
+        flat_ids = jnp.clip(flat_raw, 0, w.shape[0] - 1)
+        gathered = jnp.take(jax.lax.stop_gradient(w), flat_ids, axis=0)
         gathered = gathered.astype(virtuals[w_name].dtype) + virtuals[w_name]
         out = gathered.reshape(ids.shape + (w.shape[1],))
-        env["__sparse_ids__" + w_name] = flat_ids
+        # the optimizer-facing id list maps masked ids (< 0, output zeroed
+        # below ⇒ zero grad row) to V — the merge_rows invalid index — so
+        # the row-wise update DROPS them instead of lazily decaying row 0's
+        # moments every step
+        env["__sparse_ids__" + w_name] = jnp.where(
+            flat_raw < 0, jnp.asarray(w.shape[0], flat_ids.dtype), flat_ids)
     else:
-        out = jnp.take(w, jnp.maximum(ids, 0), axis=0)
+        out = jnp.take(w, jnp.clip(ids, 0, w.shape[0] - 1), axis=0)
     out = jnp.where((ids >= 0)[..., None], out, jnp.zeros_like(out))
     if padding_idx is not None and padding_idx >= 0:
         out = jnp.where((ids == padding_idx)[..., None], jnp.zeros_like(out), out)

@@ -30,6 +30,86 @@ def _sparse(g):
     return g if isinstance(g, SparseGrad) else None
 
 
+def _sparse_kernel_mode():
+    """Resolve ``FLAGS_sparse_update_kernel`` for this trace: None = XLA
+    scatter path, "compiled"/"interpret" = the row-DMA Pallas kernel
+    (pallas_kernels/sparse_adam.py). "auto" compiles on TPU and keeps the
+    scatter path elsewhere — the interpreter is a correctness tool, not a
+    fast CPU path."""
+    from ..flags import flags
+
+    mode = str(flags.sparse_update_kernel).lower()
+    if mode in ("0", "off", "false", "no"):
+        return None
+    if mode == "interpret":
+        return "interpret"
+    on_tpu = jax.default_backend() == "tpu"
+    if mode in ("1", "on", "true", "yes"):
+        return "compiled" if on_tpu else "interpret"
+    return "compiled" if on_tpu else None  # auto
+
+
+def _table_mesh_sharding(ctx, param):
+    """(mesh, axis) when this op's Param table is row-sharded over a live
+    mesh axis (parallel.sharded_embedding annotation) — the signal to route
+    the update through core.sparse.sharded_rows_update instead of a global
+    scatter (which would gather the table)."""
+    mesh = getattr(ctx.trace, "mesh", None)
+    if mesh is None:
+        return None
+    names = ctx.op.inputs.get("Param")
+    if not names:
+        return None
+    try:
+        var = ctx.var(names[0])
+    except Exception:
+        return None
+    spec = getattr(var, "sharding", None)
+    from ..executor import _valid_sharding
+
+    if not spec or spec[0] is None or not _valid_sharding(spec, mesh):
+        return None
+    axis = spec[0]
+    n = mesh.shape[axis]
+    if n <= 1:
+        return None
+    if param.shape[0] % n:
+        # uneven rows can't take the shard-local path; the global-scatter
+        # fallback re-materializes the full table per step — loud, because
+        # at the V this feature exists for that IS the OOM being avoided
+        import warnings
+
+        warnings.warn(
+            "sparse table %r: V=%d not divisible by mesh axis %r (n=%d); "
+            "falling back to the full-table scatter update. Pad the vocab "
+            "to a multiple of the axis size to keep updates shard-local."
+            % (names[0], param.shape[0], axis, n))
+        return None
+    return mesh, axis
+
+
+def _use_alltoall(n_ids, n_shards):
+    from ..flags import flags
+
+    return bool(flags.ctr_alltoall_update) and n_ids % n_shards == 0
+
+
+def _kernel_for(param, *moments):
+    """(kmode, interpret) when the row-DMA kernel should carry this update
+    — FLAGS gate resolved AND sparse_rows_supported (pltpu importable, f32
+    tables); (None, False) means the scatter formulation."""
+    from .pallas_kernels.sparse_adam import sparse_rows_supported
+
+    kmode = _sparse_kernel_mode()
+    if kmode is None:
+        return None, False
+    if not sparse_rows_supported(param.shape[0], param.shape[1], param.dtype):
+        return None, False
+    if any(t.dtype != jnp.float32 for t in moments):
+        return None, False
+    return kmode, kmode == "interpret"
+
+
 @register_op("sgd")
 def sgd_op(ctx: OpContext):
     p, g = ctx.input("Param"), ctx.input("Grad")
@@ -37,8 +117,49 @@ def sgd_op(ctx: OpContext):
     if sg is not None:
         # SelectedRows branch (reference: sgd_op.h sparse path): touch only
         # the looked-up rows; duplicate ids accumulate in the scatter-add.
+        lr = _lr(ctx).astype(p.dtype)
+        sharded = _table_mesh_sharding(ctx, p)
+        if sharded is not None:
+            from ..core.sparse import merge_rows, sharded_rows_update
+
+            mesh, axis = sharded
+            uniq, merged = merge_rows(sg.ids, sg.rows.astype(p.dtype),
+                                      p.shape[0])
+            kmode, interp = _kernel_for(p)
+
+            def _upd(tabs, lid, rows_l, lr_s):
+                (p_l,) = tabs
+                if kmode is not None:
+                    # the row-DMA kernel runs per shard on the local
+                    # [V/n, D] slice; foreign/pad ids arrive as the local
+                    # OOB (== shard rows) and the kernel drops their writes
+                    from .pallas_kernels.sparse_adam import sparse_sgd_rows
+
+                    return (sparse_sgd_rows(p_l, lid, rows_l, lr_s,
+                                            interpret=interp),)
+                return (p_l.at[lid].add(-lr_s * rows_l),)
+
+            (p_new,) = sharded_rows_update(
+                (p,), uniq, merged, _upd, mesh, axis, scalars=(lr,),
+                alltoall=_use_alltoall(uniq.shape[0], mesh.shape[axis]))
+            ctx.set_output("ParamOut", p_new)
+            return
+        kmode, interp = _kernel_for(p)
+        if kmode is not None:
+            # one row-DMA kernel instead of the XLA scatter pass
+            # (SPARSE_PROFILE.md §1/§4); merge first — the kernel wants
+            # unique rows, and XLA drops the merge padding's OOB id just
+            # like the scatter would
+            from ..core.sparse import merge_rows
+            from .pallas_kernels.sparse_adam import sparse_sgd_rows
+
+            uniq, merged = merge_rows(sg.ids, sg.rows.astype(p.dtype),
+                                      p.shape[0])
+            ctx.set_output("ParamOut", sparse_sgd_rows(
+                p, uniq, merged, lr, interpret=interp))
+            return
         ctx.set_output("ParamOut", p.at[sg.ids].add(
-            -_lr(ctx).astype(p.dtype) * sg.rows.astype(p.dtype)))
+            -lr * sg.rows.astype(p.dtype)))
         return
     ctx.set_output("ParamOut", p - _lr(ctx).astype(p.dtype) * g.astype(p.dtype))
 
@@ -107,6 +228,62 @@ def adam_op(ctx: OpContext):
 
         uniq, merged = merge_rows(sg.ids, sg.rows.astype(jnp.float32),
                                   p.shape[0])
+        ctx.set_output("Beta1PowOut", b1p * b1)
+        ctx.set_output("Beta2PowOut", b2p * b2)
+        sharded = _table_mesh_sharding(ctx, p)
+        b1f = float(ctx.attr("beta1", 0.9))
+        b2f = float(ctx.attr("beta2", 0.999))
+        epsf = float(ctx.attr("epsilon", 1e-8))
+        if sharded is not None:
+            # row-sharded table (parallel.sharded_embedding): shard-local
+            # rows-only updates — param AND both moments stay [V/n, D] per
+            # device, nothing ever gathers the table
+            from ..core.sparse import sharded_rows_update
+
+            mesh, axis = sharded
+            kmode, interp = _kernel_for(p, m, v)
+
+            def _upd(tabs, lid, rows_l, lr_s):
+                p_l, m_l, v_l = tabs
+                if kmode is not None:
+                    # the two tentpole halves compose: the row-DMA kernel
+                    # runs per shard on the local [V/n, D] slices (foreign/
+                    # pad ids arrive as the local OOB == shard rows, whose
+                    # writes the kernel drops)
+                    from .pallas_kernels.sparse_adam import sparse_adam_rows
+
+                    return sparse_adam_rows(p_l, m_l, v_l, lid, rows_l,
+                                            lr_s, b1f, b2f, epsf,
+                                            interpret=interp)
+                m_old, v_old = m_l[lid], v_l[lid]
+                m_rows = b1 * m_old + (1 - b1) * rows_l
+                v_rows = b2 * v_old + (1 - b2) * jnp.square(rows_l)
+                step = lr_s * m_rows / (jnp.sqrt(v_rows) + eps)
+                return (p_l.at[lid].add(-step.astype(p_l.dtype)),
+                        m_l.at[lid].add(m_rows - m_old),
+                        v_l.at[lid].add(v_rows - v_old))
+
+            p_new, m_new, v_new = sharded_rows_update(
+                (p, m, v), uniq, merged, _upd, mesh, axis,
+                scalars=(lr_t,),
+                alltoall=_use_alltoall(uniq.shape[0], mesh.shape[axis]))
+            ctx.set_output("ParamOut", p_new)
+            ctx.set_output("Moment1Out", m_new)
+            ctx.set_output("Moment2Out", v_new)
+            return
+        kmode, interp = _kernel_for(p, m, v)
+        if kmode is not None:
+            # one row-DMA Pallas kernel replaces the three ~30 GB/s scatter
+            # fusions (SPARSE_PROFILE.md §1 → §4)
+            from .pallas_kernels.sparse_adam import sparse_adam_rows
+
+            p_new, m_new, v_new = sparse_adam_rows(
+                p, m, v, uniq, merged, lr_t,
+                beta1=b1f, beta2=b2f, epsilon=epsf, interpret=interp)
+            ctx.set_output("ParamOut", p_new)
+            ctx.set_output("Moment1Out", m_new)
+            ctx.set_output("Moment2Out", v_new)
+            return
         m_old, v_old = m[uniq], v[uniq]
         m_rows = b1 * m_old + (1 - b1) * merged
         v_rows = b2 * v_old + (1 - b2) * jnp.square(merged)
@@ -118,8 +295,6 @@ def adam_op(ctx: OpContext):
         # the DeepFM step), and the old rows are already gathered
         ctx.set_output("Moment1Out", m.at[uniq].add(m_rows - m_old))
         ctx.set_output("Moment2Out", v.at[uniq].add(v_rows - v_old))
-        ctx.set_output("Beta1PowOut", b1p * b1)
-        ctx.set_output("Beta2PowOut", b2p * b2)
         return
     gf = g.astype(jnp.float32)
     m_new = b1 * m + (1 - b1) * gf

@@ -5,10 +5,18 @@ underperforms (the role of the reference's hand-tuned ``operators/jit/`` —
 Kernels:
 - softmax_xent: fused softmax + cross-entropy over large vocab
   (forward never materializes the [N, V] probabilities in HBM).
+- sparse_adam: row-wise sparse Adam/SGD update — batched dynamic-slice row
+  DMA replacing the three ~30 GB/s XLA scatter fusions on SelectedRows
+  embedding updates (benchmarks/SPARSE_PROFILE.md §1).
 
 Each kernel has an XLA-composed reference implementation it is numerically
-tested against, and ``benchmarks/bench_softmax_xent.py`` measures the win on
-real TPU hardware.
+tested against, and ``benchmarks/bench_softmax_xent.py`` /
+``benchmarks/diag_sparse.py`` measure the win on real TPU hardware.
 """
 
 from .softmax_xent import fused_softmax_xent, softmax_xent_supported  # noqa: F401
+from .sparse_adam import (  # noqa: F401
+    sparse_adam_rows,
+    sparse_rows_supported,
+    sparse_sgd_rows,
+)

@@ -125,6 +125,16 @@ class Optimizer:
         # marks the var as per-param optimizer state so BuildStrategy's
         # ReduceStrategy.Reduce (ZeRO-1) can shard it over the data axis
         var.is_optimizer_state = True
+        # a sharded parameter's same-shape accumulators (Adam moments on a
+        # row-sharded embedding table) inherit its mesh layout: each device
+        # holds V/n rows of param AND moments, and the startup twin carries
+        # the annotation too so its fill_constant materializes shard-by-shard
+        spec = getattr(param, "sharding", None)
+        if spec is not None and list(shape) == list(param.shape):
+            var.sharding = tuple(spec)
+            sb = helper.startup_program.global_block
+            if sb.has_var(acc_name):
+                sb.var(acc_name).sharding = tuple(spec)
         self._accumulators.setdefault(name, {})[param.name] = var
         return var
 

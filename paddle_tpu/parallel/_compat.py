@@ -1,0 +1,27 @@
+"""shard_map across JAX versions.
+
+Newer JAX exposes ``jax.shard_map`` whose replication-check kwarg is
+``check_vma``; older releases have ``jax.experimental.shard_map.shard_map``
+with ``check_rep``. Every shard_map call site in the package routes through
+:func:`shard_map` so the whole multi-chip surface (pipeline, MoE, ring
+attention, sharded sparse updates) works on either.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, mesh, in_specs, out_specs, check=False):
+    """``shard_map`` with the replication check disabled, any JAX version."""
+    try:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check)
+    except TypeError:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check)

@@ -68,25 +68,45 @@ def shard_constraint(x: Variable, spec: Sequence[Optional[str]], name=None) -> V
 
 
 def sharded_embedding(input, size, mesh_axis="model", param_attr=None,
-                      dtype="float32", padding_idx=None, name=None):
+                      dtype="float32", padding_idx=None, is_sparse=False,
+                      name=None):
     """Embedding with the table row-sharded over ``mesh_axis``.
 
     The idiomatic replacement of the reference's distributed lookup table
     (prefetch_op + listen_and_serv sparse path): XLA partitions the gather,
     each device holds V/n rows in HBM, and the result is all-gathered over
     ICI — no parameter server.
+
+    ``is_sparse=True`` is the CTR-scale composition (``slice_variable`` +
+    trainer-side sparse prefetch in one mechanism): the gradient stays a
+    rows-only ``SparseGrad``, the optimizer update runs shard-local through
+    ``core.sparse.sharded_rows_update`` (ids/rows reach owner shards via
+    replication or, with ``FLAGS_ctr_alltoall_update``, an explicit
+    ``all_to_all`` id exchange), the Adam moments inherit the row sharding,
+    and the startup initializer materializes the table shard-by-shard — so
+    param AND optimizer state cost V/n rows per device and no dense [V, D]
+    buffer ever exists. At V=1e8, D=10, n=8 that is ~500 MB/chip for the
+    table and ~1.5 GB/chip including both Adam moments, where the
+    single-device init RESOURCE_EXHAUSTs outright.
     """
     from .. import layers
+    from ..core.framework import default_startup_program
 
     helper = LayerHelper("sharded_embedding", name=name)
     attr = ParamAttr.to_attr(param_attr)
     out = layers.embedding(input, size=size, param_attr=attr, dtype=dtype,
-                           padding_idx=padding_idx, name=name)
-    # the embedding layer registered the Parameter; annotate its rows
+                           padding_idx=padding_idx, is_sparse=is_sparse,
+                           name=name)
+    # the embedding layer registered the Parameter; annotate its rows — and
+    # its startup twin, so the init op can materialize it shard-by-shard
+    # instead of building the full [V, D] array on one device
     emb_op = out.op
     w_name = emb_op.input("W")[0]
     w_var = out.block.var(w_name)
     annotate_sharding(w_var, (mesh_axis, None))
+    sb = default_startup_program().global_block
+    if sb.has_var(w_name):
+        annotate_sharding(sb.var(w_name), (mesh_axis, None))
     return out
 
 

@@ -19,10 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.4.35 exposes shard_map at top level
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map as _shard_map
+from ._compat import shard_map as _shard_map
 
 __all__ = ["switch_moe", "make_switch_ffn"]
 
@@ -76,7 +73,7 @@ def switch_moe(x, gate_w, expert_params, expert_fn: Callable, mesh: Mesh,
     expert_out = _shard_map(
         shard_body, mesh=mesh,
         in_specs=(jax.tree.map(lambda _: P(axis), expert_params), P(axis)),
-        out_specs=P(axis), check_vma=False,
+        out_specs=P(axis),
     )(expert_params, expert_in)
 
     # combine: gather each token's expert output, weight by its gate prob

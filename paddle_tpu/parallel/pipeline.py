@@ -31,10 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.4.35 exposes shard_map at top level
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map as _shard_map
+from ._compat import shard_map as _shard_map
 
 __all__ = ["gpipe", "pipeline_step", "stack_stage_params"]
 
@@ -128,7 +125,6 @@ def gpipe(stage_fn: Callable, mesh: Mesh, axis: str = "pipe"):
         )
         out = _shard_map(
             shard_body, mesh=mesh, in_specs=in_specs, out_specs=P(axis),
-            check_vma=False,
         )(stacked_params, microbatches)
         return out[:m] if mpad != m else out
 

@@ -281,7 +281,7 @@ def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
     and the composed reference otherwise — both through the same FA2-style
     custom-VJP ring, so backward memory is O(S_local) residuals either way
     (the pre-r4 autodiff-through-scan path saved per-step score blocks)."""
-    shard_map = jax.shard_map
+    from ._compat import shard_map
 
     if batch_axis is None:
         batch_axis = "data" if "data" in mesh.axis_names else None
@@ -292,7 +292,7 @@ def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
     fn = functools.partial(_ring_blockwise, axis_name, causal, sm_scale,
                            use_flash)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)(q, k, v)
+                     out_specs=spec)(q, k, v)
 
 
 @register_op("ring_attention")

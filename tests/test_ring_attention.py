@@ -124,12 +124,13 @@ def test_ring_blockwise_residuals_are_linear_in_s():
     def local(q, k, v):
         return _ring_blockwise_fwd("sp", True, 0.25, False, q, k, v)
 
-    out, res = jax.shard_map(
+    from paddle_tpu.parallel._compat import shard_map
+
+    out, res = shard_map(
         local, mesh=mesh,
         in_specs=(P(None, None, "sp", None),) * 3,
         out_specs=(P(None, None, "sp", None),
-                   (P(None, None, "sp", None),) * 4 + (P(None, None, "sp"),)),
-        check_vma=False)(q, q, q)
+                   (P(None, None, "sp", None),) * 4 + (P(None, None, "sp"),)))(q, q, q)
     assert out.shape == q.shape
     q_r, k_r, v_r, out_r, lse_r = res
     assert lse_r.shape == (b, h, s)          # O(S) softmax stats
