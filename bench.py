@@ -1241,10 +1241,16 @@ def main():
             print(json.dumps(res))
             sys.exit(1)
         eff = res.get("transformer", {}).get("scaling_efficiency")
+        from paddle_tpu.monitor import device as _dev
+
         print(json.dumps({
             "metric": "scaling_efficiency_1_to_%d" % res.get("n_devices", 0),
             "value": eff, "unit": "ratio", "vs_baseline": eff,
-            "detail": res, "metrics": _monitor_metrics_section()}))
+            "detail": res,
+            # per-device bytes the explicit collective sites move per step
+            # (trace-time accounting; GSPMD-inserted collectives excluded)
+            "collectives": _dev.collectives_snapshot(),
+            "metrics": _monitor_metrics_section()}))
         return
 
     peak, kind = _device_peak_flops()
@@ -1457,6 +1463,11 @@ def main():
     except Exception as e:
         detail["deepfm_ctr"] = {"error": repr(e)[:200]}
 
+    try:
+        device_profile = _device_profile_section()
+    except Exception as e:
+        device_profile = {"error": repr(e)[:200]}
+
     vs = (tfm_eps / ROUND1_BASELINE_EXAMPLES_PER_SEC
           if ROUND1_BASELINE_EXAMPLES_PER_SEC else 1.0)
     print(json.dumps({
@@ -1465,6 +1476,7 @@ def main():
         "unit": "examples/sec",
         "vs_baseline": round(vs, 3),
         "detail": detail,
+        "device_profile": device_profile,
         "metrics": _monitor_metrics_section(),
     }))
     # the compact per-config digest is the LAST line on purpose: a log tail
@@ -1536,6 +1548,41 @@ def _graph_opt_section():
         "softmax_xent_rewrites": val(
             "passes/softmax_xent_fuse_pass/rewrites_matched"),
     }}
+
+
+def _device_profile_section(batch=64):
+    """The ``device_profile`` section: per-op flops/bytes attribution +
+    measured XLA cost/memory analysis for the canonical MLP train config
+    (tools/profile_report's demo shape at bench batch). AOT-compiled via
+    ``Executor.prepare`` — one extra small compile, no step execution —
+    so every bench JSON carries a roofline table whose ``slot`` ids match
+    the ``<slot>:<type>`` named scopes in any xprof trace taken alongside.
+    Render it with ``python -m tools.profile_report <bench.json>``."""
+    import paddle_tpu as fluid
+    from paddle_tpu.monitor import device as _dev
+
+    with fluid.unique_name.guard():
+        with fluid.scope_guard(fluid.Scope()):
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data("x", shape=[32])
+                y = fluid.layers.data("y", shape=[1], dtype="int64")
+                h = fluid.layers.fc(x, size=64, act="relu")
+                logits = fluid.layers.fc(h, size=10)
+                loss = fluid.layers.mean(
+                    fluid.layers.softmax_with_cross_entropy(logits, y))
+                fluid.optimizer.SGD(0.1).minimize(loss)
+            exe = fluid.Executor(fluid.TPUPlace(0))
+            exe.run(startup)
+            compiled = exe.prepare(
+                main, feed={"x": ((batch, 32), "float32"),
+                            "y": ((batch, 1), "int64")},
+                fetch_list=[loss])
+    rep = _dev.step_report(compiled.program,
+                           getattr(compiled, "_aot", None),
+                           batch_size=batch, top=12)
+    rep["config"] = "mlp_train_b%d" % batch
+    return rep
 
 
 def _monitor_metrics_section():

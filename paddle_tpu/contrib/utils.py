@@ -5,9 +5,12 @@ contrib/memory_usage_calc.py:46 ``memory_usage``): sum of op-output tensor
 sizes with the batch dim substituted, returned as a (lower, upper, unit)
 band. On TPU this is a pre-compile sanity number only — XLA's buffer
 assignment reuses/donates aggressively, so the authoritative figure for a
-COMPILED step is ``compiled.memory_analysis()`` (see
-Executor/_CompiledStep); this API exists for parity and for sizing batch
-before paying a compile.
+COMPILED step is ``compiled.memory_analysis()``, exposed as
+``Executor.memory_report(program, feed=..., fetch_list=...)``: it
+AOT-compiles the specialization without running it and returns
+argument/output/temp/peak-HBM bytes (also published as the
+``device_profile/*`` monitor gauges). This API exists for parity and for
+sizing batch before paying that compile.
 
 ``op_freq_statistic`` — op-type frequency histogram (reference:
 contrib/op_frequence.py ``op_freq_statistic``): single-op counts plus
@@ -33,7 +36,8 @@ def memory_usage(program: Program, batch_size: int):
 
     Returns ``(lower, upper, unit_str)`` — the reference's 5%-10% headroom
     band over the summed op-output sizes (batch dims, encoded as -1,
-    multiplied out by ``batch_size``).
+    multiplied out by ``batch_size``). For the authoritative compiled-step
+    figure use ``Executor.memory_report`` (module docstring).
     """
     if not isinstance(program, Program):
         raise TypeError("Calculating Memory Usage requires Program as its "

@@ -65,8 +65,10 @@ def _oom_hint(e: BaseException, op) -> str:
         "is_sparse=True) keeps gradients + optimizer updates rows-only, and "
         "parallel.sharded_embedding(..., mesh_axis=...) row-shards the "
         "table AND its Adam moments over a device mesh (V/n rows per "
-        "device, initialized shard-by-shard) — see README \"Sparse & CTR\"."
-        % detail)
+        "device, initialized shard-by-shard) — see README \"Sparse & CTR\". "
+        "Executor.memory_report(program, feed=..., fetch_list=...) gives "
+        "the compiled step's authoritative peak-HBM figure WITHOUT running "
+        "it — size the fix against that number." % detail)
 
 
 def wrap_op_error(e: BaseException, op, op_index: int, env=None) -> EnforceNotMet:

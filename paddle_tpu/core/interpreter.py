@@ -5,6 +5,21 @@ executes exactly once per compilation, inside ``jax.jit`` tracing, so the
 per-step cost is zero. Shared by the Executor and by control-flow ops
 (while/cond/recurrent), which recursively interpret sub-blocks inside
 ``lax.while_loop``/``lax.cond``/``lax.scan`` bodies.
+
+Device-side observability rides this loop because it IS the trace:
+
+* each op impl runs under ``jax.named_scope("<slot>:<type>")`` (gated by
+  ``PADDLE_TPU_OP_SCOPES``, resolved once per trace on the TraceContext),
+  so HLO/xprof/cost_analysis carry Program-op identity at zero step cost;
+* with the numerics watchdog armed (``trace.watch`` is the compiled step's
+  layout list), every op's floating outputs contribute one ``isfinite``
+  bit to ``env[NUMERICS_ENV_KEY]`` — a traced list that legally flows out
+  of ``jax.value_and_grad`` as aux, unlike a side list of tracers.
+
+``<slot>`` is ``__op_slot__`` when the trace-time optimizer stamped it
+(``passes.analysis.stamp_op_slots`` — original position in the source
+program, stable under DCE/CSE renumbering) and the positional index
+otherwise.
 """
 
 from __future__ import annotations
@@ -16,9 +31,28 @@ from .registry import OpContext, get_op_impl
 # Ops that are markers/IO and never execute as kernels.
 SKIP_OPS = frozenset({"backward_marker", "feed", "fetch"})
 
+# The env key watchdog bits accumulate under inside the traced name->array
+# environment (they flow out of jax.value_and_grad as part of the env aux
+# legally, unlike a side list, which would leak tracers). THE defining
+# copy — the executor and monitor.device import it from here.
+NUMERICS_ENV_KEY = "__numerics__"
+
+# sub-blocks interpret at offset 10_000*block_idx (ops/control_flow_ops.py);
+# watchdog bits must NOT be collected there — a bit created inside a
+# lax.while/scan body cannot be stacked outside it (tracer leak), and the
+# sub-block op's own outputs already give per-loop attribution at the top
+# level. Named scopes (pure metadata) stay on everywhere.
+_SUB_BLOCK_OFFSET = 10_000
+
 
 def run_block_ops(ops, env: Dict[str, Any], trace, offset: int = 0):
     from .enforce import EnforceNotMet, wrap_op_error
+
+    scopes = getattr(trace, "op_scopes", False)
+    watch = getattr(trace, "watch", None) if offset < _SUB_BLOCK_OFFSET \
+        else None
+    if scopes:
+        import jax
 
     for i, op in enumerate(ops):
         if op.type in SKIP_OPS:
@@ -26,19 +60,61 @@ def run_block_ops(ops, env: Dict[str, Any], trace, offset: int = 0):
         trace.current_op_idx = offset + i
         impl = get_op_impl(op.type)
         try:
-            impl(OpContext(op, env, trace))
+            if scopes:
+                slot = op.attrs.get("__op_slot__")
+                with jax.named_scope(
+                        "%d:%s" % (offset + i if slot is None else slot,
+                                   op.type)):
+                    impl(OpContext(op, env, trace))
+            else:
+                impl(OpContext(op, env, trace))
         except EnforceNotMet:
             raise  # already enriched (nested blocks)
         except NotImplementedError:
             raise  # registry gap message is already the good pattern
         except Exception as e:
             raise wrap_op_error(e, op, offset + i, env) from e
+        if watch is not None:
+            _watch_op_outputs(op, env, watch, offset + i)
+
+
+def _watch_op_outputs(op, env: Dict[str, Any], layout, pos: int) -> None:
+    """Fold each floating output of ``op`` into one isfinite bit appended
+    to ``env[NUMERICS_ENV_KEY]``; record (label, outputs) at the same index
+    in ``layout`` (index-overwrite, so jit retraces never duplicate)."""
+    import jax.numpy as jnp
+
+    bit = None
+    outs = []
+    for name in op.output_arg_names:
+        v = env.get(name)
+        dt = getattr(v, "dtype", None)
+        if dt is None or not jnp.issubdtype(dt, jnp.floating):
+            continue
+        ok = jnp.isfinite(v).all()
+        bit = ok if bit is None else jnp.logical_and(bit, ok)
+        outs.append(name)
+    if bit is None:
+        return
+    bits = env.setdefault(NUMERICS_ENV_KEY, [])
+    k = len(bits)
+    slot = op.attrs.get("__op_slot__")
+    entry = ("%d:%s" % (pos if slot is None else slot, op.type), tuple(outs))
+    if k < len(layout):
+        layout[k] = entry
+    else:
+        layout.append(entry)
+    bits.append(bit)
 
 
 class PerStepTrace:
     """Trace proxy for loop bodies (lax.scan/while): folds the (traced) step
     index into every op's PRNG key so stochastic ops (dropout etc.) draw a
     fresh mask per timestep instead of reusing the trace-time constant."""
+
+    # loop bodies never collect watchdog bits (they'd leak across the lax
+    # boundary); class attr masks the inner trace's list
+    watch = None
 
     def __init__(self, inner, step_index):
         self._inner = inner

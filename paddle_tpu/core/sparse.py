@@ -67,11 +67,16 @@ def route_rows_to_shards(ids, rows, n_shards, shard_size, axis_name,
                           sid.dtype).at[flat].set(sid)
     bucket_rows = jnp.zeros((n_shards * n_loc,) + rows.shape[1:],
                             rows.dtype).at[flat].set(srows)
-    recv_ids = jax.lax.all_to_all(
-        bucket_ids.reshape(n_shards, n_loc), axis_name, 0, 0)
-    recv_rows = jax.lax.all_to_all(
-        bucket_rows.reshape((n_shards, n_loc) + rows.shape[1:]),
-        axis_name, 0, 0)
+    from ..monitor.device import record_collective
+
+    send_ids = bucket_ids.reshape(n_shards, n_loc)
+    send_rows = bucket_rows.reshape((n_shards, n_loc) + rows.shape[1:])
+    # trace-time byte accounting: these are the PS-style id/row exchange's
+    # per-device per-step volumes (benchmarks/COLLECTIVES.md §7 — measured)
+    record_collective("all_to_all", axis_name, send_ids)
+    record_collective("all_to_all", axis_name, send_rows)
+    recv_ids = jax.lax.all_to_all(send_ids, axis_name, 0, 0)
+    recv_rows = jax.lax.all_to_all(send_rows, axis_name, 0, 0)
     return recv_ids.reshape(-1), recv_rows.reshape((-1,) + rows.shape[1:])
 
 

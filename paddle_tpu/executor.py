@@ -30,11 +30,11 @@ from .core.dtypes import to_jnp_dtype
 from .core.framework import (Program, Variable, default_main_program,
                              grad_var_name, in_test_mode)
 from .flags import flags as _flags
-from .core.interpreter import run_block_ops
+from .core.interpreter import NUMERICS_ENV_KEY as _NUMERICS_ENV_KEY, run_block_ops
 from .core.place import Place, get_device
 from .core.registry import OpContext, get_op_impl
 from .core.scope import Scope, global_scope
-from .monitor import GRAD_NORM_VAR, metrics as _mx, tracer as _tr
+from .monitor import GRAD_NORM_VAR, device as _dev, metrics as _mx, tracer as _tr
 
 __all__ = ["Executor", "FetchHandle", "TraceContext"]
 
@@ -221,19 +221,63 @@ class FetchHandle:
             pass
 
 
+@jax.jit
+def _finite_all(vals):
+    """ONE fused device-side isfinite reduction over a list of float
+    arrays → a scalar bool. The whole NaN check is then a single
+    scalar device sync instead of the legacy full-model host copy
+    (every fetch AND state entry through np.asarray, per step)."""
+    ok = jnp.bool_(True)
+    for v in vals:
+        ok = jnp.logical_and(ok, jnp.isfinite(v).all())
+    return ok
+
+
 def _enforce_step_flags(fetch_names, fetches, state):
-    """FLAGS_benchmark device sync (reference: operator.cc:942) and
-    FLAGS_check_nan_inf post-step scan (operator.cc:947) — the one epilogue
-    both drivers (run() and run_steps) must apply identically."""
+    """FLAGS_benchmark device sync (reference: operator.cc:942) and the
+    FLAGS_check_nan_inf post-step check (operator.cc:947) — the one epilogue
+    both drivers (run() and run_steps) must apply identically.
+
+    The NaN check is a fused device-side reduction (see ``_finite_all``);
+    its scalar fetch is the only sync, and after FLAGS_benchmark's
+    block_until_ready it is free — the two flags compose without a second
+    sync or any host copy. Only the (rare) failure path walks the values on
+    host to recover the legacy error message's offending label.
+    ``PADDLE_TPU_CHECK_NUMERICS>=1`` arms the same check without the legacy
+    flag; level 2's per-op mask (checked before this) already attributed
+    the op, so this stays the fetch/state-level backstop."""
     if _flags.benchmark:
         jax.block_until_ready((state, fetches))
-    if _flags.check_nan_inf:
-        for label, val in list(zip(fetch_names, fetches)) + list(state.items()):
+    if _flags.check_nan_inf or _dev.numerics_level() >= 1:
+        labeled = list(zip(fetch_names, fetches)) + list(state.items())
+        vals = [v for _, v in labeled
+                if getattr(v, "dtype", None) is not None
+                and jnp.issubdtype(v.dtype, jnp.floating)]
+        if not vals or bool(_finite_all(vals)):  # one scalar device sync
+            return
+        for label, val in labeled:
             arr = np.asarray(val)
             if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
                 raise RuntimeError(
                     "FLAGS_check_nan_inf: non-finite values in %r after op "
                     "execution" % label)
+        raise RuntimeError(
+            "FLAGS_check_nan_inf: non-finite values after op execution")
+
+
+def _safe_flight_dump(fr, reason, exc):
+    """Crash-path flight-recorder dump: an unwritable PADDLE_TPU_FLIGHT_DIR
+    (or a serialization hiccup) must never REPLACE the step error the dump
+    exists to explain."""
+    if fr is None:
+        return
+    try:
+        fr.dump(reason, exc)
+    except Exception as dump_err:
+        from .log import vlog
+
+        vlog(0, "flight-recorder dump failed (%r); original error preserved",
+             dump_err)
 
 
 def _mesh_repl(mesh):
@@ -275,6 +319,11 @@ class TraceContext:
         self.current_op_idx = 0
         self._key_table = None
         self._n_ops = 0
+        # device-side observability (monitor/device.py): op-identity named
+        # scopes (trace-time-only cost, resolved once per trace) and the
+        # numerics-watchdog layout list the owning _CompiledStep arms
+        self.op_scopes = _dev.op_scopes_enabled()
+        self.watch = None
 
     def op_rng(self, ctx: OpContext):
         # RNG-stability contract (passes/analysis.py): an optimizer pass may
@@ -341,13 +390,20 @@ class _CompiledStep:
     def __init__(self, program: Program, feed_names: Tuple[str, ...],
                  fetch_names: Tuple[str, ...], state_names: Tuple[str, ...],
                  is_test: bool, jit: bool = True, mesh=None,
-                 accumulation_steps: int = 1):
+                 accumulation_steps: int = 1, numerics: bool = False):
         self.program = program
         self.feed_names = feed_names
         self.fetch_names = fetch_names
         self.state_names = state_names
         self.is_test = is_test
         self.mesh = mesh
+        # PADDLE_TPU_CHECK_NUMERICS=2: this specialization is the GUARDED
+        # variant — every op's floating outputs feed an isfinite bit into a
+        # packed mask appended as a hidden trailing fetch; watch_layout maps
+        # mask bit k -> (op label, output names), written at trace time
+        # (index-overwrite, so jit retraces never desync it).
+        self.numerics = bool(numerics)
+        self.watch_layout: list = []
 
         bw = program._backward_info
         block = program.global_block
@@ -383,6 +439,8 @@ class _CompiledStep:
             # cost per run); step_idx is the only changing input
             rng_key = jax.random.fold_in(jax.random.PRNGKey(seed_const), step_idx)
             trace = TraceContext(program, is_test, rng_key, mesh=mesh)
+            if self.numerics:
+                trace.watch = self.watch_layout
             if bw is None or marker_idx is None:
                 env = dict(state)
                 env.update(feeds)
@@ -489,9 +547,20 @@ class _CompiledStep:
                         fwd, has_aux=True)(params, {}, sub0)
 
                     def _mb_step(carry, sub):
-                        g_acc, l_acc, _ = carry
+                        g_acc, l_acc, env_prev = carry
                         (li, env_i), gi = jax.value_and_grad(
                             fwd, has_aux=True)(params, {}, sub)
+                        if self.numerics:
+                            # AND the watchdog bits across microbatches —
+                            # carrying only env_i would drop every earlier
+                            # microbatch's forward bits and misattribute a
+                            # mid-accumulation NaN to the optimizer ops
+                            prev = env_prev.get(_NUMERICS_ENV_KEY)
+                            cur = env_i.get(_NUMERICS_ENV_KEY)
+                            if prev and cur:
+                                env_i[_NUMERICS_ENV_KEY] = [
+                                    jnp.logical_and(a, b)
+                                    for a, b in zip(prev, cur)]
                         g_acc = jax.tree_util.tree_map(jnp.add, g_acc, gi)
                         return (g_acc, l_acc + li, env_i), None
 
@@ -526,6 +595,14 @@ class _CompiledStep:
                         val, self._out_state_sh[n])
                 new_state[n] = val
             fetches = [env[f] for f in self.fetch_names]
+            if self.numerics:
+                # the packed watchdog mask rides as the LAST hidden fetch
+                # (after the grad-norm probe, which is part of fetch_names);
+                # run()/run_steps pop it first and attribute failures via
+                # watch_layout
+                bits = env.get(_NUMERICS_ENV_KEY)
+                fetches.append(jnp.stack(bits) if bits
+                               else jnp.ones((1,), jnp.bool_))
             return new_state, fetches
 
         # the raw (unjitted) step closure: _CompiledStepChain scans over it
@@ -633,16 +710,17 @@ class _DispatchPlan:
     """
 
     __slots__ = ("feed_specs", "fetch_names", "run_fetch_names",
-                 "grad_norm_fetch", "state_names", "avail_names", "compiled",
-                 "key", "put_specs", "batch_sh", "mesh_repl")
+                 "grad_norm_fetch", "numerics", "state_names", "avail_names",
+                 "compiled", "key", "put_specs", "batch_sh", "mesh_repl")
 
     def __init__(self, feed_specs, fetch_names, run_fetch_names,
-                 grad_norm_fetch, state_names, avail_names, compiled, key,
-                 put_specs=None, batch_sh=None, mesh_repl=None):
+                 grad_norm_fetch, numerics, state_names, avail_names,
+                 compiled, key, put_specs=None, batch_sh=None, mesh_repl=None):
         self.feed_specs = feed_specs  # tuple of (name, np.dtype, shape)
         self.fetch_names = fetch_names
         self.run_fetch_names = run_fetch_names
         self.grad_norm_fetch = grad_norm_fetch
+        self.numerics = numerics  # guarded variant: watchdog mask fetch last
         self.state_names = state_names
         self.avail_names = avail_names  # state vars present at plan build
         self.compiled = compiled
@@ -831,41 +909,69 @@ class Executor:
 
         rng_key = self._next_step_index(src_program)
         state, feeds = self._place(plan, state, feeds, mesh)
+        fr = _dev.flight_recorder()  # None unless PADDLE_TPU_FLIGHT_DIR set
+        if fr is not None:
+            # fingerprint the SOURCE program (the one the user can inspect;
+            # watchdog slots are source-relative); the optimized clone's
+            # fingerprint rides along for compile-cache correlation
+            fr.record_step(
+                "run", src_program, plan.feed_specs, fetch_names,
+                extra={"optimized": _dev.program_fingerprint(program)})
         t_step = time.perf_counter() if mx_on else 0.0
-        if tr_on:
-            with _tr.span("executor/compile_and_step" if was_miss
-                          else "executor/step", cat="executor"):
+        try:
+            if tr_on:
+                with _tr.span("executor/compile_and_step" if was_miss
+                              else "executor/step", cat="executor"):
+                    new_state, fetches = compiled(state, feeds, rng_key)
+            else:
                 new_state, fetches = compiled(state, feeds, rng_key)
-        else:
-            new_state, fetches = compiled(state, feeds, rng_key)
-        if mx_on:
-            # A cache-miss first call pays jit trace + XLA compile; report it
-            # separately so the steady-state step histogram stays clean. On
-            # async backends the hit-path number is dispatch wall time (add
-            # FLAGS_benchmark for a per-step device sync).
-            dt_ms = (time.perf_counter() - t_step) * 1e3
-            (_m_compile_ms if was_miss else _m_step_ms).observe(dt_ms)
-            _m_runs.inc()
-            if feeds:
-                _m_feed_bytes.inc(_nbytes(feeds.values()))
-            # HBM gauges are a coarse signal; sampling on miss + every Nth
-            # run keeps the per-device memory_stats() calls off the
-            # steady-state dispatch path
-            if was_miss or int(_m_runs.value) % _HBM_SAMPLE_EVERY == 0:
-                _update_hbm_gauges()
-        aux = None
-        if plan.grad_norm_fetch:
-            # opt-in (PADDLE_TPU_GRAD_NORM=1 at graph-build time): the gauge
-            # read is a scalar device sync, so it rides the FetchHandle's
-            # resolve path instead of blocking the dispatch loop here
-            aux = fetches[-1]
-            fetches = fetches[:-1]
-
-        _enforce_step_flags(fetch_names, fetches, new_state)
-
-        for n, v in new_state.items():
-            if v is not None:
-                scope.set_var(n, v)
+            if mx_on:
+                # A cache-miss first call pays jit trace + XLA compile;
+                # report it separately so the steady-state step histogram
+                # stays clean. On async backends the hit-path number is
+                # dispatch wall time (add FLAGS_benchmark for a per-step
+                # device sync).
+                dt_ms = (time.perf_counter() - t_step) * 1e3
+                (_m_compile_ms if was_miss else _m_step_ms).observe(dt_ms)
+                _m_runs.inc()
+                if feeds:
+                    _m_feed_bytes.inc(_nbytes(feeds.values()))
+                # HBM gauges are a coarse signal; sampling on miss + every
+                # Nth run keeps the per-device memory_stats() calls off the
+                # steady-state dispatch path
+                if was_miss or int(_m_runs.value) % _HBM_SAMPLE_EVERY == 0:
+                    _update_hbm_gauges()
+            if was_miss and compiled.jitted and _dev.profile_enabled():
+                self._publish_device_profile(compiled, new_state, feeds)
+            mask = None
+            if plan.numerics:
+                # the packed per-op isfinite mask is the LAST hidden fetch
+                mask = fetches[-1]
+                fetches = fetches[:-1]
+            aux = None
+            if plan.grad_norm_fetch:
+                # opt-in (PADDLE_TPU_GRAD_NORM=1 at graph-build time): the
+                # gauge read is a scalar device sync, so it rides the
+                # FetchHandle's resolve path instead of blocking the
+                # dispatch loop here
+                aux = fetches[-1]
+                fetches = fetches[:-1]
+            # write the new state back BEFORE the numerics checks: donation
+            # consumed the scope's old buffers at dispatch, so raising first
+            # would leave the scope pointing at deleted arrays — writing the
+            # (possibly non-finite) state keeps a watchdog failure
+            # recoverable/inspectable, mirroring run_steps' finally-flush
+            for n, v in new_state.items():
+                if v is not None:
+                    scope.set_var(n, v)
+            if mask is not None:
+                _dev.check_numerics_mask(mask, compiled.watch_layout)
+            _enforce_step_flags(fetch_names, fetches, new_state)
+        except Exception as e:
+            if fr is None:
+                fr = _dev.flight_recorder()
+            _safe_flight_dump(fr, "executor.run", e)
+            raise
 
         if not fetch_names:
             if aux is not None:
@@ -895,6 +1001,11 @@ class Executor:
         # a hidden extra fetch appended to the user's fetch list.
         grad_norm_fetch = bool(mx_on and GRAD_NORM_VAR in block.vars
                                and GRAD_NORM_VAR not in fetch_names)
+        # PADDLE_TPU_CHECK_NUMERICS=2 compiles a GUARDED step variant (per-op
+        # isfinite mask, _CompiledStep numerics=True) — part of both cache
+        # keys so flipping the env var mid-process re-specializes instead of
+        # silently reusing the unguarded step
+        numerics = _dev.numerics_level() >= 2
         feed_names = tuple(sorted(feed))
         mesh_id = id(mesh) if mesh is not None else None
         # shapes are part of the key so alternating batch shapes (the last
@@ -904,7 +1015,7 @@ class Executor:
         feed_shapes = tuple(getattr(feed[n], "shape", None)
                             for n in feed_names)
         plan_key = (feed_names, feed_shapes, fetch_names, is_test, mesh_id,
-                    accumulation_steps, grad_norm_fetch)
+                    accumulation_steps, grad_norm_fetch, numerics)
 
         plans = None
         if use_program_cache:
@@ -976,6 +1087,7 @@ class Executor:
             is_test,
             mesh_id,
             accumulation_steps,
+            numerics,
         )
         compiled = self._cache.get(key) if use_program_cache else None
         was_miss = compiled is None
@@ -1001,6 +1113,7 @@ class Executor:
                     jit=is_training_or_has_feed,
                     mesh=mesh,
                     accumulation_steps=accumulation_steps,
+                    numerics=numerics,
                 )
             if mx_on:
                 _m_trace_ms.observe((time.perf_counter() - t_build) * 1e3)
@@ -1026,8 +1139,9 @@ class Executor:
             batch_sh = NamedSharding(mesh, _mesh_batch_spec(mesh))
 
         plan = _DispatchPlan(tuple(feed_specs), fetch_names, run_fetch_names,
-                             grad_norm_fetch, state_names, avail_state_names,
-                             compiled, key, put_specs, batch_sh, mesh_repl)
+                             grad_norm_fetch, numerics, state_names,
+                             avail_state_names, compiled, key, put_specs,
+                             batch_sh, mesh_repl)
         if plans is not None:
             plans[plan_key] = plan
         return plan, feeds, state, was_miss
@@ -1196,6 +1310,7 @@ class Executor:
 
         mx_on = _mx._enabled
         tr_on = _tr._active
+        fr = _dev.flight_recorder()  # None unless PADDLE_TPU_FLIGHT_DIR set
         rows: List[Any] = []      # return_numpy=True: one row per step
         handles: List[FetchHandle] = []  # else: one handle per fused chunk
         state = None
@@ -1268,6 +1383,12 @@ class Executor:
                     compiled, chain_miss = self._chain_for(plan, n)
                     chunk_was_miss = chunk_was_miss or chain_miss
 
+                if fr is not None:
+                    fr.record_step(
+                        "run_steps", src_program, plan.feed_specs,
+                        fetch_names,
+                        extra={"chunk_steps": n,
+                               "optimized": _dev.program_fingerprint(program)})
                 t0 = time.perf_counter() if mx_on else 0.0
                 if tr_on:
                     with _tr.span("executor/run_steps_chunk", cat="executor",
@@ -1292,12 +1413,22 @@ class Executor:
                         _update_hbm_gauges()
                 consumed += n
 
-                _enforce_step_flags(plan.run_fetch_names, fetches, state)
-
+                mask = None
+                if plan.numerics:
+                    # the per-op isfinite mask rides last; a fused chunk's is
+                    # stacked [n, K], so a NaN is attributed to BOTH the
+                    # originating op and the step inside the chunk — the old
+                    # post-step scan saw only the k-th step's fetches
+                    mask = fetches[-1]
+                    fetches = fetches[:-1]
                 aux = None
                 if plan.grad_norm_fetch:
                     aux = fetches[-1]
                     fetches = fetches[:-1]
+                if mask is not None:
+                    _dev.check_numerics_mask(mask, plan.compiled.watch_layout,
+                                             driver="run_steps")
+                _enforce_step_flags(plan.fetch_names, fetches, state)
                 if not fetch_names:
                     if aux is not None:
                         FetchHandle((), (), aux)._consume_aux()
@@ -1310,6 +1441,11 @@ class Executor:
                 else:
                     arrs = handle.numpy()
                     rows.extend([a[i] for a in arrs] for i in range(n))
+        except Exception as e:
+            if fr is None:
+                fr = _dev.flight_recorder()
+            _safe_flight_dump(fr, "executor.run_steps", e)
+            raise
         finally:
             # Donation consumed the scope's old state buffers at the first
             # dispatch — write the live carry back even on an error mid-loop.
@@ -1421,12 +1557,61 @@ class Executor:
                                     getattr(v, "dtype", np.float32))
             for n, v in state.items()}
         t0 = time.perf_counter()
-        compiled.fn.lower(
+        lowered = compiled.fn.lower(
             abstract_state, abstract,
-            jax.ShapeDtypeStruct((), np.dtype("uint32"))).compile()
+            jax.ShapeDtypeStruct((), np.dtype("uint32")))
+        aot = lowered.compile()
         if _mx._enabled:
             _m_compile_ms.observe((time.perf_counter() - t0) * 1e3)
+        # the AOT artifacts are the attribution surface: the executable's
+        # cost_analysis/memory_analysis feed the device_profile/* gauges
+        # (memory_report, tools/profile_report read them), and the lowered
+        # module keeps the FULL per-op named-scope coverage that XLA's
+        # fusion passes strip from the compiled text
+        # (monitor.device.lowered_scope_text) — free here, prepare() paid
+        # the lower+compile anyway
+        compiled._lowered = lowered
+        compiled._aot = aot
+        _dev.publish_compiled_analysis(aot)
         return compiled
+
+    @staticmethod
+    def _publish_device_profile(compiled, state, feeds):
+        """``PADDLE_TPU_DEVICE_PROFILE=1`` compile-miss hook: AOT-lower this
+        specialization at abstract shapes and publish the device_profile/*
+        gauges. Costs an extra trace (+ an XLA compile served from the
+        persistent cache when ``PADDLE_TPU_COMPILE_CACHE`` is set) — a
+        debug opt-in, never on the default path, never raising into the
+        step."""
+        try:
+            abstract_state, abstract_feeds = jax.tree_util.tree_map(
+                lambda v: jax.ShapeDtypeStruct(
+                    tuple(getattr(v, "shape", ())),
+                    getattr(v, "dtype", np.float32)),
+                (state, feeds))
+            aot = compiled.fn.lower(
+                abstract_state, abstract_feeds,
+                jax.ShapeDtypeStruct((), np.dtype("uint32"))).compile()
+            compiled._aot = aot
+            _dev.publish_compiled_analysis(aot)
+        except Exception as e:
+            from .log import vlog
+
+            vlog(1, "device-profile analysis failed: %r", e)
+
+    def memory_report(self, program=None, feed=None, fetch_list=None,
+                      scope=None):
+        """The authoritative pre-run memory figure for a compiled step:
+        AOT-compile the (program, feed-spec) specialization WITHOUT running
+        it and return ``compiled.memory_analysis()`` as a dict
+        (``argument_bytes`` / ``output_bytes`` / ``temp_bytes`` /
+        ``peak_hbm_bytes`` ...). ``feed`` takes the same abstract specs as
+        :meth:`prepare` (``(shape, dtype)`` tuples suffice). Run the startup
+        program first so parameters are part of the figure. This is the
+        number ``contrib.utils.memory_usage``'s pre-trace estimate defers
+        to, and the first thing to check after a RESOURCE_EXHAUSTED."""
+        compiled = self.prepare(program, feed, fetch_list, scope)
+        return _dev.memory_report_from(getattr(compiled, "_aot", None))
 
     # Fluid parity alias
     def infer_from_program(self, *a, **kw):

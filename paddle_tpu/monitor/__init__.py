@@ -17,6 +17,12 @@ TPU-native in three pieces:
 * :mod:`~paddle_tpu.monitor.step_logger` — ``StepLogger``, the periodic
   throughput/step-time/loss line emitter used by ``bench.py`` and
   ``train/``; its ``summary()`` is the ``metrics`` section of bench JSON.
+* :mod:`~paddle_tpu.monitor.device` — the DEVICE-side layer: per-op
+  named-scope attribution in HLO/xprof + ``device_profile/*``
+  cost/memory gauges, the in-graph numerics watchdog
+  (``PADDLE_TPU_CHECK_NUMERICS``), explicit-collective byte accounting
+  (``collectives/*``), and the crash flight recorder
+  (``PADDLE_TPU_FLIGHT_DIR``).
 
 Quick tour::
 
@@ -33,7 +39,7 @@ from __future__ import annotations
 
 import os
 
-from . import metrics, tracer  # noqa: F401
+from . import device, metrics, tracer  # noqa: F401
 from .metrics import (  # noqa: F401
     counter, gauge, histogram, enabled, enable, disable,
     snapshot, to_json, to_text, reset,
@@ -41,7 +47,7 @@ from .metrics import (  # noqa: F401
 from .step_logger import StepLogger  # noqa: F401
 
 __all__ = [
-    "metrics", "tracer", "StepLogger",
+    "device", "metrics", "tracer", "StepLogger",
     "counter", "gauge", "histogram", "enabled", "enable", "disable",
     "snapshot", "to_json", "to_text", "reset",
     "GRAD_NORM_VAR", "grad_norm_enabled",

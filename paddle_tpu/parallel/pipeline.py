@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..monitor.device import record_collective as _record_collective
 from ._compat import shard_map as _shard_map
 
 __all__ = ["gpipe", "pipeline_step", "stack_stage_params"]
@@ -93,6 +94,7 @@ def gpipe(stage_fn: Callable, mesh: Mesh, axis: str = "pipe"):
                 feed = x_loc[loc]
                 if owner != 0:
                     # owner ships microbatch t to stage 0 (mb-sized ICI hop)
+                    _record_collective("ppermute", axis, feed)
                     feed = jax.lax.ppermute(feed, axis, [(owner, 0)])
             else:
                 feed = jnp.zeros_like(recv)
@@ -106,10 +108,14 @@ def gpipe(stage_fn: Callable, mesh: Mesh, axis: str = "pipe"):
                 owner_out, loc_out = divmod(done, mloc)
                 w = y
                 if owner_out != s - 1:
+                    _record_collective("ppermute", axis, w)
                     w = jax.lax.ppermute(w, axis, [(s - 1, owner_out)])
                 out = out.at[loc_out].set(
                     jnp.where(idx == owner_out, w, out[loc_out]))
             if t < ticks - 1:
+                # the unrolled tick loop traces each hop separately, so the
+                # collectives/ppermute counters sum to the true per-step total
+                _record_collective("ppermute", axis, y)
                 recv = jax.lax.ppermute(y, axis, fwd_perm)
         return out
 

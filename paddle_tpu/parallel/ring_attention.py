@@ -21,6 +21,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.registry import OpContext, register_op
+from ..monitor.device import record_collective as _record_collective
 
 __all__ = ["ring_attention"]
 
@@ -205,6 +206,10 @@ def _ring_blockwise_fwd(axis_name, causal, sm_scale, use_flash, q, k, v):
         v_next = lax.ppermute(v_blk, axis_name, perm)
         return (k_next, v_next, acc, a + bb, m_new), None
 
+    # byte accounting for the scan-body rotations: 2 buffers x n hops/step
+    _record_collective("ppermute", axis_name, k, per_step_calls=n)
+    _record_collective("ppermute", axis_name, v, per_step_calls=n)
+
     acc0 = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
     l0 = jnp.zeros((b, h, s_loc), jnp.float32)
     m0 = jnp.full((b, h, s_loc), _NEG_INF, jnp.float32)
@@ -258,6 +263,17 @@ def _ring_blockwise_bwd(axis_name, causal, sm_scale, use_flash, res, do):
         dk_next = lax.ppermute(dk_blk, axis_name, perm)
         dv_next = lax.ppermute(dv_blk, axis_name, perm)
         return (k_next, v_next, dk_next, dv_next, dq_acc), None
+
+    # bwd ring rotates K/V (input dtype) and travels the dK/dV
+    # accumulators (f32) — 4 buffers x n hops/step
+    _record_collective("ppermute", axis_name, k, per_step_calls=n)
+    _record_collective("ppermute", axis_name, v, per_step_calls=n)
+    _record_collective("ppermute", axis_name,
+                       jax.ShapeDtypeStruct(k.shape, jnp.float32),
+                       per_step_calls=n)
+    _record_collective("ppermute", axis_name,
+                       jax.ShapeDtypeStruct(v.shape, jnp.float32),
+                       per_step_calls=n)
 
     dk0 = jnp.zeros(k.shape, jnp.float32)
     dv0 = jnp.zeros(v.shape, jnp.float32)

@@ -27,8 +27,8 @@ from typing import Dict, Iterable, List, Optional, Set
 __all__ = [
     "RNG_OPS", "SIDE_EFFECT_OPS", "MARKER_OPS", "CSE_PURE_OPS", "FOLDABLE_OPS",
     "has_sub_block", "is_opaque", "use_counts", "producer_map",
-    "attr_referenced_names", "stamp_rng_slots", "protected_names",
-    "remove_ops_by_id", "prune_dead_vars",
+    "attr_referenced_names", "stamp_rng_slots", "stamp_op_slots",
+    "protected_names", "remove_ops_by_id", "prune_dead_vars",
 ]
 
 # Ops that draw from the per-step PRNG (directly or via ctx.rng()). Their
@@ -164,6 +164,21 @@ def producer_map(block) -> Dict[str, object]:
         for n in op.output_arg_names:
             prod[n] = op
     return prod
+
+
+def stamp_op_slots(program) -> None:
+    """Freeze every op's original position into ``__op_slot__`` — the
+    device-side attribution identity: ``jax.named_scope`` labels, the
+    numerics watchdog and ``tools/profile_report`` all report
+    ``<slot>:<type>``, so op deletion/motion by the passes never shifts
+    a reported op identity away from the SOURCE program's numbering.
+    Idempotent (already-stamped ops keep their slot); ops inserted by
+    later rewrites carry no stamp and fall back to their position.
+    CSE ignores ``__*__`` framework attrs when value-numbering, so the
+    stamp can never block a merge (cse.py ``_attr_key``)."""
+    for i, op in enumerate(program.global_block.ops):
+        if "__op_slot__" not in op.attrs:
+            op.attrs["__op_slot__"] = i
 
 
 def stamp_rng_slots(program) -> None:

@@ -26,6 +26,11 @@ __all__ = ["CommonSubexpressionEliminationPass"]
 def _attr_key(attrs):
     items = []
     for k in sorted(attrs):
+        if k.startswith("__") and k.endswith("__"):
+            # framework-private stamps (__op_slot__, __rng_slot__) carry
+            # per-op IDENTITY, not semantics — keying on them would make
+            # every stamped op unique and defeat CSE entirely
+            continue
         v = attrs[k]
         try:
             hash(v)

@@ -150,6 +150,9 @@ def optimize_program(program, fetch_names: Optional[Iterable[str]] = None,
     work._rng_table_n = getattr(
         program, "_rng_table_n", len(program.global_block.ops) + 8)
     A.stamp_rng_slots(work)
+    # freeze per-op attribution identity (named scopes, numerics watchdog)
+    # BEFORE any pass deletes/moves ops — same contract as the RNG slots
+    A.stamp_op_slots(work)
 
     protected = A.protected_names(work, fetch_names or ())
     builder = default_pipeline(scope=scope, fetch_names=fetch_names,

@@ -154,6 +154,44 @@ def selftest() -> int:
             snap = metrics.snapshot()
             assert snap["executor/run_steps_dispatches"]["value"] == 2
             assert snap["executor/run_steps_steps"]["value"] == 4
+            # 4a. device-profile gauges: prepare() AOT-compiles and must
+            #     mirror the XLA cost/memory analyses into the gauges
+            exe.prepare(main_prog,
+                        feed={"x": ((2, 4), "float32"),
+                              "y": ((2, 1), "int64")},
+                        fetch_list=[loss])
+            snap = metrics.snapshot()
+            assert snap["device_profile/flops"]["value"] > 0, \
+                "prepare() did not publish cost_analysis"
+            assert snap["device_profile/peak_hbm_bytes"]["value"] > 0
+    # 4b. numerics watchdog packed-mask path: PADDLE_TPU_CHECK_NUMERICS=2
+    #     compiles the guarded step variant; a planted NaN must be
+    #     attributed to the ORIGINATING op by <slot>:<type>, not a fetch
+    from paddle_tpu.core.enforce import EnforceNotMet
+
+    prev = os.environ.get("PADDLE_TPU_CHECK_NUMERICS")
+    os.environ["PADDLE_TPU_CHECK_NUMERICS"] = "2"
+    try:
+        with fluid.unique_name.guard():
+            with fluid.scope_guard(fluid.Scope()):
+                m2, s2 = fluid.Program(), fluid.Program()
+                with fluid.program_guard(m2, s2):
+                    x = fluid.layers.data("x", shape=[4])
+                    bad = fluid.layers.log(x)  # log(0) -> -inf at THIS op
+                    out = fluid.layers.mean(bad)
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(s2)
+                try:
+                    exe.run(m2, feed={"x": np.zeros((2, 4), "float32")},
+                            fetch_list=[out])
+                    raise AssertionError("watchdog missed the planted NaN")
+                except EnforceNotMet as e:
+                    assert ":log" in str(e), str(e)
+    finally:
+        if prev is None:
+            os.environ.pop("PADDLE_TPU_CHECK_NUMERICS", None)
+        else:
+            os.environ["PADDLE_TPU_CHECK_NUMERICS"] = prev
     metrics.reset()
     print("dump_metrics selftest: OK")
     return 0

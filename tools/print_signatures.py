@@ -30,6 +30,7 @@ MODULES = [
     "paddle_tpu.io",
     "paddle_tpu.metrics",
     "paddle_tpu.monitor",
+    "paddle_tpu.monitor.device",
     "paddle_tpu.monitor.metrics",
     "paddle_tpu.monitor.tracer",
     "paddle_tpu.nets",

@@ -1,0 +1,242 @@
+"""Per-op roofline report for a compiled paddle_tpu step.
+
+The device-profile twin of tools/dump_metrics.py / tools/dump_program.py
+(monitor/device.py is the library; this renders it):
+
+    python -m tools.profile_report
+        AOT-compile the canned MLP train step (the diag_overhead.py probe
+        shape) and print the per-op flops/bytes/%-of-step roofline table
+        plus the compiled step's measured cost_analysis/memory_analysis
+        totals.
+
+    python -m tools.profile_report --model DIR [--batch N]
+        Same, over a saved inference model (io.load_inference_model).
+
+    python -m tools.profile_report bench.json
+        Render the ``device_profile`` section a bench.py run embedded in
+        its JSON (no recompilation, works off-host).
+
+    python -m tools.profile_report --selftest
+        Exercise the whole path in-process on CPU (<5s) and exit 0/1 —
+        a CI smoke gate alongside the dump_metrics/dump_program selftests.
+
+Reading the table: ``flops``/``bytes`` are ANALYTIC first-order rows from
+static Program shapes — attribution weights that apportion the step, not a
+simulator. The measured truth is the compiled totals up top (XLA fuses
+across op boundaries). ``intensity`` = flops/byte decides which side of
+the roofline an op lives on: below the device's flops/byte ridge point it
+is HBM-bound (optimize traffic), above it compute-bound (optimize flops).
+``slot`` is the op's position in the SOURCE program — identical to the
+``<slot>:<type>`` named scopes in HLO/xprof and to numerics-watchdog
+reports, stable under the trace-time optimizer's DCE/CSE renumbering.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _fmt_si(v: float) -> str:
+    for div, suf in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "K")):
+        if abs(v) >= div:
+            return "%.2f%s" % (v / div, suf)
+    return "%.0f" % v
+
+
+def render(report: dict, top: int = 0) -> str:
+    """Text table for a ``monitor.device.step_report`` dict (also the
+    bench-JSON ``device_profile`` section)."""
+    lines = []
+    cost = report.get("cost") or {}
+    mem = report.get("memory") or {}
+    if cost:
+        lines.append("measured (XLA cost_analysis, whole compiled step):")
+        for k in ("flops", "bytes_accessed", "transcendentals"):
+            if k in cost:
+                lines.append("  %-22s %s" % (k, _fmt_si(cost[k])))
+    if mem:
+        lines.append("measured (XLA memory_analysis):")
+        for k in ("argument_bytes", "output_bytes", "temp_bytes",
+                  "peak_hbm_bytes"):
+            if k in mem:
+                lines.append("  %-22s %s" % (k, _fmt_si(mem[k])))
+    rows = report.get("op_costs") or []
+    if top:
+        rows = rows[:top]
+    lines.append("analytic per-op attribution (%d op(s), total %s flops):"
+                 % (report.get("n_ops", len(rows)),
+                    _fmt_si(report.get("analytic_total_flops", 0.0))))
+    lines.append("%5s %-28s %10s %10s %10s %7s %7s  %s"
+                 % ("slot", "type", "flops", "bytes", "flops/B",
+                    "%step", "cum%", "out"))
+    cum = 0.0
+    for r in rows:
+        cum += r.get("flops_frac", 0.0)
+        lines.append("%5d %-28s %10s %10s %10.2f %6.1f%% %6.1f%%  %s"
+                     % (r["slot"], r["type"], _fmt_si(r["flops"]),
+                        _fmt_si(r["bytes"]), r["intensity"],
+                        100 * r.get("flops_frac", 0.0), 100 * cum,
+                        r.get("out", "")))
+    return "\n".join(lines)
+
+
+def _demo_mlp(fluid):
+    """The canned MLP train step (same family as diag_overhead.py's
+    probe): fc/relu x2 + softmax_with_cross_entropy + SGD."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[32])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=64, act="relu")
+        logits = fluid.layers.fc(h, size=10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def report_program(main, startup, loss_name, feed_spec, batch: int) -> dict:
+    """AOT-compile the (program, feed-spec) step and build the full
+    device-profile report (measured totals + analytic rows + scope
+    coverage of the lowered HLO)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.monitor import device as dev
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        if startup is not None:
+            exe.run(startup)
+        compiled = exe.prepare(main, feed=feed_spec,
+                               fetch_list=[loss_name] if loss_name else [])
+    aot = getattr(compiled, "_aot", None)
+    rep = dev.step_report(compiled.program, aot, batch_size=batch)
+    lowered = getattr(compiled, "_lowered", None)
+    try:
+        if lowered is not None:
+            rep["scope_coverage"] = dev.op_scope_coverage(
+                dev.lowered_scope_text(lowered))
+        elif aot is not None:
+            rep["scope_coverage"] = dev.op_scope_coverage(aot.as_text())
+    except Exception:
+        pass
+    return rep
+
+
+def _run_demo(batch: int = 16) -> dict:
+    import paddle_tpu as fluid
+
+    main, startup, loss = _demo_mlp(fluid)
+    return report_program(
+        main, startup, loss.name,
+        {"x": ((batch, 32), "float32"), "y": ((batch, 1), "int64")}, batch)
+
+
+def _run_model(model_dir: str, batch: int) -> dict:
+    import paddle_tpu as fluid
+    from paddle_tpu import io
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        prog, feed_names, fetch_targets = io.load_inference_model(
+            model_dir, exe)
+        block = prog.global_block
+        feed_spec = {}
+        for n in feed_names:
+            v = block.var(n)
+            shape = tuple(batch if (d or 0) < 0 else d
+                          for d in (v.shape or ()))
+            feed_spec[n] = (shape, str(v.dtype))
+        compiled = exe.prepare(
+            prog, feed=feed_spec,
+            fetch_list=[t.name for t in fetch_targets])
+    from paddle_tpu.monitor import device as dev
+
+    return dev.step_report(compiled.program, getattr(compiled, "_aot", None),
+                           batch_size=batch)
+
+
+def selftest() -> int:
+    import time
+
+    t0 = time.time()
+    from paddle_tpu.monitor import device as dev, metrics as mx
+
+    mx.enable()
+    rep = _run_demo(batch=8)
+    # analytic rows exist and the matmuls dominate as they must in an MLP
+    rows = rep["op_costs"]
+    assert rows, "no analytic op rows"
+    assert any(r["type"] in ("mul", "matmul") and r["flops"] > 0
+               for r in rows), "matmul rows missing flops"
+    # fracs are rounded to 4 decimals in the report, so sum within ~n*5e-5
+    assert abs(sum(r["flops_frac"] for r in rows) - 1.0) < 1e-2
+    # measured compiled totals came back on CPU
+    assert rep.get("cost", {}).get("flops", 0) > 0, "cost_analysis empty"
+    assert rep.get("memory", {}).get("peak_hbm_bytes", 0) > 0
+    # the <slot>:<type> named scopes survived into the lowered HLO
+    cov = rep.get("scope_coverage") or {}
+    assert cov, "no named scopes in compiled HLO"
+    assert any(k.split(":", 1)[1] in ("mul", "matmul") for k in cov), cov
+    # gauges mirrored by the prepare() path
+    snap = mx.snapshot()
+    assert snap.get("device_profile/flops", {}).get("value", 0) > 0
+    assert snap.get("device_profile/peak_hbm_bytes", {}).get("value", 0) > 0
+    txt = render(rep, top=12)
+    assert "slot" in txt and "%step" in txt
+    # renders from a bench-JSON-shaped dict too (round-trip through json)
+    render(json.loads(json.dumps(rep)))
+    dt = time.time() - t0
+    assert dt < 5.0, "selftest too slow: %.1fs" % dt
+    print("profile_report selftest: OK (%d rows, %.1fs)" % (len(rows), dt))
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 0
+    if "--selftest" in argv:
+        return selftest()
+    batch = 16
+    if "--batch" in argv:
+        i = argv.index("--batch")
+        batch = int(argv[i + 1])
+        del argv[i:i + 2]
+    top = 0
+    if "--top" in argv:
+        i = argv.index("--top")
+        top = int(argv[i + 1])
+        del argv[i:i + 2]
+    if "--model" in argv:
+        rep = _run_model(argv[argv.index("--model") + 1], batch)
+    elif argv and os.path.isfile(argv[0]):
+        with open(argv[0]) as f:
+            doc = json.load(f)
+        rep = doc.get("device_profile", doc)
+        if not rep.get("op_costs"):
+            print("no device_profile section in %s" % argv[0],
+                  file=sys.stderr)
+            return 1
+    else:
+        rep = _run_demo(batch)
+    print(render(rep, top=top))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # e.g. piped into head
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)
