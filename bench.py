@@ -1232,6 +1232,32 @@ def main():
     pipeline = "--pipeline" in sys.argv
     if pipeline:
         sys.argv.remove("--pipeline")
+    if len(sys.argv) > 1 and sys.argv[1] == "--serve":
+        # serving-stack leg (paddle_tpu.serving): ragged continuous batching
+        # + paged KV-cache vs the padded static-batch baseline on one
+        # synthetic mixed-length stream. CPU-sim OK; the compact summary
+        # (p50/p99 latency + sustained QPS) rides the truncation-proof tail.
+        from tools import serve_bench as _sb
+
+        res = _sb.serve_bench()
+        cont = res["continuous_paged"]
+        print(json.dumps({
+            "metric": "serving_sustained_qps_mixed_stream",
+            "value": cont["qps"],
+            "unit": "requests/sec",
+            "vs_baseline": res["qps_ratio_vs_padded"],
+            "detail": res,
+            "metrics": _monitor_metrics_section(),
+        }))
+        print(json.dumps({"summary": {"serve": {
+            "qps": cont["qps"],
+            "latency_p50_ms": cont["latency_p50_ms"],
+            "latency_p99_ms": cont["latency_p99_ms"],
+            "tokens_per_sec": cont["tokens_per_sec"],
+            "qps_ratio_vs_padded": res["qps_ratio_vs_padded"],
+        }}}))
+        return 0
+
     if len(sys.argv) > 1 and sys.argv[1] == "--mesh":
         if len(sys.argv) < 3:
             print(json.dumps({"error": "usage: bench.py --mesh data=8"}))
@@ -1396,7 +1422,12 @@ def main():
                 "examples_per_sec": round(dr_eps, 2),
                 "note": "natural raw JAX: dense scatter grads + full-table "
                         "adam — scales with V where the sparse path doesn't"}
-            detail["deepfm_ctr"]["overhead_vs_raw_jax"] = round(
+            # named for what it measures (VERDICT demand 8): the raw-JAX twin
+            # is DENSE (full-table scatter+adam), so against the sparse
+            # framework path this is a cross-mode ratio, not framework
+            # overhead — deepfm_ctr_dense.overhead_vs_raw_jax is the
+            # apples-to-apples framework-overhead number
+            detail["deepfm_ctr"]["overhead_vs_dense_raw_jax"] = round(
                 dr_eps / df_eps, 4)
             if "examples_per_sec" in detail.get("deepfm_ctr_dense", {}):
                 detail["deepfm_ctr_dense"]["overhead_vs_raw_jax"] = round(
@@ -1502,6 +1533,10 @@ def _compact_summary(detail):
             row["mfu"] = ent["mfu_est"]
         if "overhead_vs_raw_jax" in ent:
             row["overhead"] = ent["overhead_vs_raw_jax"]
+        elif "overhead_vs_dense_raw_jax" in ent:
+            # deepfm_ctr's cross-mode ratio keeps its honest name in the
+            # tail too (sparse framework vs dense raw ≠ framework overhead)
+            row["overhead_vs_dense"] = ent["overhead_vs_dense_raw_jax"]
         out[name] = row
     sweep = detail.get("deepfm_v_sweep")
     if isinstance(sweep, dict) and "error" not in sweep:

@@ -36,7 +36,7 @@ from .core.registry import OpContext, get_op_impl
 from .core.scope import Scope, global_scope
 from .monitor import GRAD_NORM_VAR, device as _dev, metrics as _mx, tracer as _tr
 
-__all__ = ["Executor", "FetchHandle", "TraceContext"]
+__all__ = ["Executor", "FetchHandle", "TraceContext", "aot_compile"]
 
 # Instruments are module-level handles: looked up once, so the per-run cost
 # with metrics ON is a few lock+add ops, and with metrics OFF a single
@@ -303,6 +303,52 @@ def _valid_sharding(spec, mesh):
     this mesh — the one predicate all sharding consumers share."""
     return spec is not None and all(
         a is None or a in mesh.axis_names for a in spec)
+
+
+def _abstractify(tree):
+    """Pytree → ShapeDtypeStructs (ShapeDtypeStructs pass through)."""
+    return jax.tree_util.tree_map(
+        lambda v: v if isinstance(v, jax.ShapeDtypeStruct)
+        else jax.ShapeDtypeStruct(tuple(getattr(v, "shape", ())),
+                                  getattr(v, "dtype", np.float32)),
+        tree)
+
+
+def _timed_lower_compile(jitted_fn, args):
+    """(lowered, executable) with the compile wall time routed to the
+    executor/compile_time_ms histogram — the one AOT timing convention
+    shared by Executor.prepare and aot_compile."""
+    t0 = time.perf_counter()
+    lowered = jitted_fn.lower(*args)
+    aot = lowered.compile()
+    if _mx._enabled:
+        _m_compile_ms.observe((time.perf_counter() - t0) * 1e3)
+    return lowered, aot
+
+
+def aot_compile(fn, abstract_args, donate_argnums=(), static_argnums=()):
+    """AOT lower + XLA-compile ``fn`` at abstract shapes WITHOUT running it
+    — ``Executor.prepare``'s artifact path exposed for non-Program drivers
+    (the serving decode engine compiles its per-bucket prefill fns and the
+    fused decode step through here).
+
+    ``abstract_args`` is a tuple of pytrees of arrays or
+    ``ShapeDtypeStruct``\\ s (only shapes/dtypes are read). Compile time
+    lands in ``executor/compile_time_ms``; with ``PADDLE_TPU_COMPILE_CACHE``
+    set the executable persists across processes, so a serving restart
+    skips every prefill/decode compile. Returns the compiled executable
+    (call it with concrete arrays; ``donate_argnums`` buffers are consumed).
+    """
+    jitted = jax.jit(fn, donate_argnums=donate_argnums,
+                     static_argnums=static_argnums)
+    static = set(static_argnums if isinstance(static_argnums, (tuple, list))
+                 else (static_argnums,))
+    # static args must reach the trace as their CONCRETE values, not shape
+    # structs — only the traced (dynamic) positions are abstractified
+    args = tuple(a if i in static else _abstractify(a)
+                 for i, a in enumerate(abstract_args))
+    _, aot = _timed_lower_compile(jitted, args)
+    return aot
 
 
 _UserCompiledProgram = None  # lazily bound CompiledProgram class (import cycle)
@@ -1552,17 +1598,10 @@ class Executor:
         compiled = plan.compiled
         if not compiled.jitted:
             return compiled
-        abstract_state = {
-            n: jax.ShapeDtypeStruct(tuple(getattr(v, "shape", ())),
-                                    getattr(v, "dtype", np.float32))
-            for n, v in state.items()}
-        t0 = time.perf_counter()
-        lowered = compiled.fn.lower(
-            abstract_state, abstract,
-            jax.ShapeDtypeStruct((), np.dtype("uint32")))
-        aot = lowered.compile()
-        if _mx._enabled:
-            _m_compile_ms.observe((time.perf_counter() - t0) * 1e3)
+        abstract_state = _abstractify(state)
+        lowered, aot = _timed_lower_compile(
+            compiled.fn, (abstract_state, abstract,
+                          jax.ShapeDtypeStruct((), np.dtype("uint32"))))
         # the AOT artifacts are the attribution surface: the executable's
         # cost_analysis/memory_analysis feed the device_profile/* gauges
         # (memory_report, tools/profile_report read them), and the lowered

@@ -123,8 +123,12 @@ def jit_train(loss_fn: Callable, layer: Layer, optimizer) -> Callable:
     built parameters and the optimizer's accumulators, whose set must be
     final before the trace); subsequent calls are compiled. Per-step
     dropout keys derive from a traced step counter, so masks differ per
-    step without retracing. Do not mix ``step()`` with manual
-    ``loss._backward()`` on the same tape in the same iteration.
+    step without retracing. Mixing ``step()`` with a manual
+    ``loss._backward()`` over the same parameters in the same iteration is
+    a HARD ERROR: the compiled step computes its own gradients inside the
+    trace and would silently ignore the eager tape's accumulated ``_grad``
+    (or double-count it into the warmup step). ``clear_gradient()`` the
+    parameters first if the manual pass was intentional.
     """
     from .tracer import current_tracer
 
@@ -212,6 +216,19 @@ def jit_train(loss_fn: Callable, layer: Layer, optimizer) -> Callable:
         return ps, bufs, slots, jax.jit(run, donate_argnums=(0, 1, 2))
 
     def step(*inputs):
+        # Same-tape mixing guard: a manual backward() since the last step
+        # left gradients the compiled step would silently ignore (or, on
+        # the eager warmup step, double-count into). The compiled path owns
+        # the whole forward/backward/update — refuse loudly.
+        stale = [p.name for p in _params() if p._grad is not None]
+        if stale:
+            raise RuntimeError(
+                "imperative.jit_train: parameter(s) %s carry gradients from "
+                "a manual backward() on the same tape; jit_train's compiled "
+                "step computes its own gradients and would silently ignore "
+                "them. Run either the compiled step OR manual "
+                "backward()+minimize() per iteration — or clear_gradient() "
+                "first if the manual pass was intentional." % stale)
         if state["compiled"] is None:
             if not layer._built or not optimizer._accumulators:
                 # warmup: one true eager step finalizes params + slots

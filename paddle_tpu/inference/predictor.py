@@ -33,6 +33,11 @@ class AnalysisConfig:
         self._use_tpu = True
         self._ir_optim = True  # accepted; XLA always optimizes
         self._memory_optim = True
+        # Round batch sizes up to power-of-two buckets so a varying-batch
+        # client compiles O(log max_batch) specializations instead of one
+        # per unique batch size (the executor's plan/compile caches key on
+        # feed shapes). Outputs are sliced back to the true batch.
+        self._batch_bucketing = True
 
     # GPU-era API parity: the accelerator here is the TPU.
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
@@ -49,6 +54,14 @@ class AnalysisConfig:
     def enable_memory_optim(self, x: bool = True):
         self._memory_optim = x
 
+    def switch_batch_bucketing(self, x: bool = True):
+        """Opt out (``switch_batch_bucketing(False)``) to compile per exact
+        batch size — e.g. when a fixed-batch client wants zero padding."""
+        self._batch_bucketing = bool(x)
+
+    def batch_bucketing(self) -> bool:
+        return self._batch_bucketing
+
     def use_gpu(self) -> bool:
         return self._use_tpu
 
@@ -62,10 +75,27 @@ class _IOHandle:
         self._is_input = is_input
 
     def copy_from_cpu(self, arr: np.ndarray):
-        self._owner._staged_inputs[self.name] = np.asarray(arr)
+        arr = np.asarray(arr)
+        want = self._owner._declared_shapes.get(self.name)
+        if want is not None and tuple(arr.shape) != want:
+            raise ValueError(
+                "input %r staged with shape %s but reshape() declared %s"
+                % (self.name, tuple(arr.shape), want))
+        self._owner._staged_inputs[self.name] = arr
 
     def reshape(self, shape):
-        pass  # shapes come from the array itself
+        """Declare the input's shape (reference ZeroCopyTensor::Reshape).
+        Validated against the staged array — a mismatch raises instead of
+        silently running with whatever was staged."""
+        if not self._is_input:
+            raise ValueError("reshape() is only valid on input handles")
+        want = tuple(int(s) for s in shape)
+        staged = self._owner._staged_inputs.get(self.name)
+        if staged is not None and tuple(staged.shape) != want:
+            raise ValueError(
+                "reshape(%s) conflicts with already-staged array of shape %s "
+                "for input %r" % (want, tuple(staged.shape), self.name))
+        self._owner._declared_shapes[self.name] = want
 
     def copy_to_cpu(self) -> np.ndarray:
         return np.asarray(self._owner._last_outputs[self.name])
@@ -88,6 +118,7 @@ class Predictor:
                     model_filename=config.prog_file,
                     params_filename=config.params_file))
         self._staged_inputs: Dict[str, np.ndarray] = {}
+        self._declared_shapes: Dict[str, tuple] = {}
         self._last_outputs: Dict[str, np.ndarray] = {}
 
     # -- modern handle API ----------------------------------------------------
@@ -106,16 +137,72 @@ class Predictor:
     # -- execution ------------------------------------------------------------
     def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
         """run([x1, x2, ...]) positional over feed names, or run() after
-        staging via input handles. Returns outputs in fetch order."""
+        staging via input handles. Returns outputs in fetch order.
+
+        With batch bucketing on (the default; ``AnalysisConfig.
+        switch_batch_bucketing(False)`` opts out), the leading dim is
+        padded up to the next power of two before the step and sliced back
+        after, bounding the compile cache to O(log max_batch) entries for a
+        varying-batch client. Models with a batch-reducing fetch fall back
+        to an exact-shape run (the reduction over padded rows would be
+        wrong); the one undetectable edge is an output whose NON-batch
+        leading dim coincidentally equals the padded batch while every
+        other output is per-row — opt out of bucketing for such models."""
         if inputs is not None:
             feed = {n: np.asarray(a) for n, a in zip(self._feed_names, inputs)}
         else:
             feed = dict(self._staged_inputs)
+            # staged inputs are consumed by the run (ZeroCopyTensor
+            # semantics): the next iteration stages fresh arrays, and a new
+            # reshape()/copy_from_cpu pair never collides with this one's
+            self._staged_inputs.clear()
+            self._declared_shapes.clear()
+        exact_feed = dict(feed) if self.config.batch_bucketing() else None
+        batch = self._bucket_batch(feed) if exact_feed is not None else None
         with scope_guard(self._scope):
             outs = self._exe.run(self._program, feed=feed,
                                  fetch_list=self._fetch_names)
+            if batch is not None:
+                real, padded = batch
+                if all(getattr(o, "shape", ()) and o.shape[0] == padded
+                       for o in outs):
+                    # every fetch is per-row: drop the padding rows
+                    outs = [o[:real] for o in outs]
+                else:
+                    # some fetch reduced over (or reshaped away) the batch
+                    # dim — its value over the padded rows would be WRONG,
+                    # and there is no way to un-reduce it. Re-run at the
+                    # exact batch: correctness wins over the bucketed
+                    # compile bound for this model (opt out of bucketing to
+                    # skip the padded attempt entirely).
+                    outs = self._exe.run(self._program, feed=exact_feed,
+                                         fetch_list=self._fetch_names)
         self._last_outputs = dict(zip(self._fetch_names, outs))
         return outs
+
+    @staticmethod
+    def _bucket_batch(feed):
+        """Pad every feed's leading dim up to the next power of two, in
+        place; returns (real_batch, padded_batch) or None when the feeds
+        don't share a positive leading dim (nothing to bucket). Padding
+        repeats the last row (edge mode) so models with log/div ops never
+        see synthetic zeros."""
+        dims = {int(v.shape[0]) for v in feed.values()
+                if getattr(v, "ndim", 0) >= 1}
+        if len(dims) != 1:
+            return None
+        real = dims.pop()
+        if real < 1 or any(getattr(v, "ndim", 0) < 1 for v in feed.values()):
+            return None
+        padded = 1
+        while padded < real:
+            padded *= 2
+        if padded == real:
+            return None
+        for n, v in feed.items():
+            feed[n] = np.pad(v, [(0, padded - real)] + [(0, 0)] * (v.ndim - 1),
+                             mode="edge")
+        return real, padded
 
 
 def create_predictor(config: AnalysisConfig) -> Predictor:

@@ -4,6 +4,6 @@ plus BERT and DeepFM from BASELINE.json's five workloads) and the book-test
 models (reference: python/paddle/fluid/tests/book/ — word2vec,
 label_semantic_roles, recommender_system)."""
 
-from . import (bert, deepfm, machine_translation, mnist, recommender, resnet,  # noqa: F401
-               se_resnext, semantic_roles, stacked_lstm, transformer, vgg,
-               word2vec)
+from . import (bert, decoder_lm, deepfm, machine_translation, mnist,  # noqa: F401
+               recommender, resnet, se_resnext, semantic_roles, stacked_lstm,
+               transformer, vgg, word2vec)

@@ -242,6 +242,32 @@ def sdpa(q, k, v, bias=None, segment_ids_q=None, segment_ids_kv=None,
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
+def decode_attention(q, ctx_k, ctx_v, ctx_len, sm_scale=1.0):
+    """Single-position attention for autoregressive decode over a gathered
+    KV context (paddle_tpu.serving).
+
+    ``q`` [B,H,D] is the current position's query per batch slot; ``ctx_k``/
+    ``ctx_v`` [B,L,H,D] is the slot's cache context — a paged gather
+    (serving.kv_cache.PagedKVCache.context) or a contiguous cache slice feed
+    the SAME math here, which is what makes the two layouts bit-comparable.
+    ``ctx_len`` [B] counts the valid leading positions (prompt + generated,
+    INCLUDING the current token, whose k/v the caller wrote before calling).
+    Invalid positions are masked with a large-negative constant whose exp
+    underflows to exactly 0.0, so cache garbage beyond ``ctx_len`` (stale
+    rows from a retired request, unreserved pages) contributes exactly
+    nothing — independent of layout. Returns [B,H,D].
+
+    This is the XLA fallback path of the serving stack's ragged paged
+    attention; a Pallas kernel fusing the page gather into the attention
+    inner loop can replace it behind the same signature.
+    """
+    scores = jnp.einsum("bhd,blhd->bhl", q, ctx_k) * sm_scale
+    mask = jnp.arange(ctx_k.shape[1])[None, None, :] < ctx_len[:, None, None]
+    scores = jnp.where(mask, scores, jnp.asarray(-1e30, scores.dtype))
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhl,blhd->bhd", probs, ctx_v)
+
+
 @register_op("scaled_dot_product_attention")
 def sdpa_op(ctx: OpContext):
     q, k, v = ctx.input("Q"), ctx.input("K"), ctx.input("V")

@@ -1,0 +1,438 @@
+"""Autoregressive decode driver: continuous batching over a paged KV-cache.
+
+The device-resident serving loop the ROADMAP's item-1 gap called for. One
+:class:`ServingEngine` owns:
+
+* a :class:`~.scheduler.Scheduler` (bounded queue → fixed batch slots,
+  continuous in-flight admission or the static wave-drain baseline),
+* a :class:`~.page_pool.PagePool` + :class:`~.kv_cache.PagedKVCache` (or
+  the :class:`~.kv_cache.ContiguousKVCache` reference layout),
+* AOT-compiled step functions built through ``executor.aot_compile`` —
+  ONE prefill executable per prompt bucket (power-of-two padded, so a
+  ragged prompt stream compiles O(log max_seq) programs, the same
+  bounded-specialization idea as the Predictor's batch buckets) and ONE
+  decode executable per fuse length whose state (KV pages, page tables,
+  slot occupancy, lengths) never leaves the device between steps — the
+  serving twin of ``Executor.run_steps``'s stack-and-scan fusion, with
+  retirement/admission decisions surfacing only at chunk boundaries.
+
+Observability rides PR 1/5's monitor: ``serving/*`` counters + latency
+histograms (serving.metrics), and the crash flight recorder captures the
+in-flight batch spec on any decode failure (``PADDLE_TPU_FLIGHT_DIR``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..executor import _safe_flight_dump, aot_compile
+from ..monitor import device as _dev
+from . import metrics as _sm
+from .kv_cache import ContiguousKVCache, PagedKVCache
+from .page_pool import PagePool, PagePoolExhausted
+from .request import Request
+from .scheduler import Scheduler
+
+__all__ = ["ServingConfig", "ServingEngine"]
+
+
+def _pow2_buckets(lo: int, hi: int) -> tuple:
+    out, b = [], lo
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(hi)
+    return tuple(sorted(set(out)))
+
+
+class ServingConfig:
+    """Engine geometry + policy knobs.
+
+    ``slots``: fixed decode batch width. ``max_seq``: per-request context
+    budget (prompt + generated), a multiple of ``page_size``. ``num_pages``
+    defaults to full-occupancy worst case (``slots * max_seq/page_size``);
+    size it SMALLER to oversubscribe — admission then backpressures on the
+    pool instead of the slots. ``decode_fuse`` fuses that many decode steps
+    into one dispatched scan (admission/retirement happen at chunk
+    boundaries — latency trades against host dispatch overhead).
+    ``continuous=False`` degrades to the padded static wave-drain baseline;
+    ``paged=False`` swaps in the contiguous reference cache. ``eos_id=None``
+    disables EOS stopping (generation runs to ``max_new_tokens``).
+    """
+
+    def __init__(self, slots: int = 8, page_size: int = 16,
+                 max_seq: int = 128, num_pages: Optional[int] = None,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 max_queue: int = 1024, eos_id: Optional[int] = None,
+                 decode_fuse: int = 1, paged: bool = True,
+                 continuous: bool = True, collect_logits: bool = False,
+                 pad_id: int = 0):
+        if max_seq % page_size != 0:
+            raise ValueError("max_seq=%d must be a multiple of page_size=%d"
+                             % (max_seq, page_size))
+        self.slots = int(slots)
+        self.page_size = int(page_size)
+        self.max_seq = int(max_seq)
+        self.num_pages = (self.slots * (self.max_seq // self.page_size)
+                          if num_pages is None else int(num_pages))
+        self.prompt_buckets = tuple(sorted(
+            prompt_buckets if prompt_buckets is not None
+            else _pow2_buckets(min(8, max_seq), max_seq)))
+        if self.prompt_buckets[-1] > self.max_seq:
+            raise ValueError("prompt bucket %d exceeds max_seq %d"
+                             % (self.prompt_buckets[-1], self.max_seq))
+        self.max_queue = int(max_queue)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.decode_fuse = max(1, int(decode_fuse))
+        self.paged = bool(paged)
+        self.continuous = bool(continuous)
+        self.collect_logits = bool(collect_logits)
+        self.pad_id = int(pad_id)
+
+
+class ServingEngine:
+    """Drives a model implementing the serving contract:
+
+    * ``model.cfg`` — exposes ``n_layer``/``n_head``/``d_head``/``max_seq``
+      /``dtype`` (models.decoder_lm.DecoderConfig shape),
+    * ``model.prefill(params, tokens[B,S], lengths[B]) -> (logits[B,S,V],
+      kvs)`` with ``kvs`` one ``(k, v)`` ``[B,S,H,D]`` pair per layer,
+    * ``model.decode(params, cache, cache_ops, tokens[B], pos[B],
+      active[B]) -> (logits[B,V], cache)``.
+    """
+
+    def __init__(self, model, config: Optional[ServingConfig] = None,
+                 params=None):
+        self.model = model
+        self.cfg = config or ServingConfig()
+        mcfg = model.cfg
+        if mcfg.max_seq < self.cfg.max_seq:
+            raise ValueError(
+                "model max_seq %d < serving max_seq %d (position table too "
+                "small for the context budget)" % (mcfg.max_seq, self.cfg.max_seq))
+        self.params = params if params is not None else model.params
+        if self.cfg.paged:
+            self.cache_ops = PagedKVCache(
+                mcfg.n_layer, mcfg.n_head, mcfg.d_head, self.cfg.slots,
+                self.cfg.max_seq, self.cfg.page_size, self.cfg.num_pages,
+                dtype=mcfg.dtype)
+            self.pool: Optional[PagePool] = PagePool(
+                self.cfg.num_pages, self.cfg.page_size)
+        else:
+            self.cache_ops = ContiguousKVCache(
+                mcfg.n_layer, mcfg.n_head, mcfg.d_head, self.cfg.slots,
+                self.cfg.max_seq, dtype=mcfg.dtype)
+            self.pool = None
+        self.scheduler = Scheduler(self.cfg.slots, self.cfg.max_queue,
+                                   continuous=self.cfg.continuous)
+        self._cache = self.cache_ops.init_state()
+        b = self.cfg.slots
+        self._len = jnp.zeros((b,), jnp.int32)
+        self._tok = jnp.zeros((b,), jnp.int32)
+        self._active = jnp.zeros((b,), jnp.bool_)
+        self._gen = jnp.zeros((b,), jnp.int32)
+        self._maxnew = jnp.ones((b,), jnp.int32)
+        self._prefill_exe: Dict[int, Any] = {}   # bucket -> AOT executable
+        self._decode_exe: Dict[int, Any] = {}    # fuse length -> executable
+        self._captured_logits: Dict[int, List[np.ndarray]] = {}
+
+    # -- public API -----------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int) -> Request:
+        """Queue a request. Raises ``ValueError`` for a request that can
+        NEVER be served at this geometry, and ``BackpressureError`` when
+        the bounded queue is full (shed/retry — transient)."""
+        req = Request(prompt, max_new_tokens)
+        if req.prompt_len > self.cfg.prompt_buckets[-1]:
+            raise ValueError(
+                "prompt length %d exceeds the largest prefill bucket %d"
+                % (req.prompt_len, self.cfg.prompt_buckets[-1]))
+        total = req.prompt_len + req.max_new_tokens
+        if total > self.cfg.max_seq:
+            raise ValueError(
+                "prompt+max_new_tokens=%d exceeds max_seq=%d" %
+                (total, self.cfg.max_seq))
+        if self.pool is not None and \
+                self.pool.pages_needed(total) > self.pool.num_pages:
+            raise ValueError(
+                "request needs %d pages but the pool only has %d"
+                % (self.pool.pages_needed(total), self.pool.num_pages))
+        return self.scheduler.submit(req)
+
+    def step(self) -> List[Request]:
+        """One multiplexer cycle: retire/admit into free slots, prefill the
+        admissions, then one fused decode dispatch. Returns requests that
+        finished during the cycle."""
+        finished = self._admit()
+        if self.scheduler.occupancy:
+            finished.extend(self._decode_dispatch())
+        return finished
+
+    def run(self, max_steps: Optional[int] = None) -> List[Request]:
+        """Drive :meth:`step` until queue and slots drain (or ``max_steps``).
+        Updates the ``serving/tokens_per_sec`` gauge over the drive."""
+        t0 = time.perf_counter()
+        tok0 = _sm.TOKENS_GENERATED.value
+        done: List[Request] = []
+        steps = 0
+        while not self.scheduler.idle():
+            if max_steps is not None and steps >= max_steps:
+                break
+            done.extend(self.step())
+            steps += 1
+        dt = time.perf_counter() - t0
+        if dt > 0:
+            _sm.TOKENS_PER_SEC.set((_sm.TOKENS_GENERATED.value - tok0) / dt)
+        return done
+
+    def captured_logits(self, req: Request) -> List[np.ndarray]:
+        """Per-emitted-token logits rows (``collect_logits=True`` only)."""
+        return self._captured_logits.get(req.id, [])
+
+    def stats(self) -> dict:
+        out = {
+            "layout": self.cache_ops.layout,
+            "queued": self.scheduler.queue_depth,
+            "running": self.scheduler.occupancy,
+            "cache_bytes": self.cache_ops.cache_bytes(self._cache),
+        }
+        if self.pool is not None:
+            out["pages_in_use"] = self.pool.num_used
+            out["page_pool_utilization"] = round(self.pool.utilization, 4)
+        return out
+
+    # -- admission + prefill --------------------------------------------------
+    def _bucket_for(self, n: int) -> int:
+        for b in self.cfg.prompt_buckets:
+            if n <= b:
+                return b
+        raise ValueError("no prefill bucket covers prompt length %d" % n)
+
+    def _admit(self) -> List[Request]:
+        finished: List[Request] = []
+        slots = self.scheduler.admissible_slots()
+        if not slots or self.scheduler.peek() is None:
+            return finished
+        wave_bucket = None
+        if not self.cfg.continuous:
+            # the padded static baseline: every prompt of the wave pays the
+            # wave-max bucket, the classic fully-padded batch
+            wave = self.scheduler.peek_n(len(slots))
+            wave_bucket = self._bucket_for(max(r.prompt_len for r in wave))
+        for slot in slots:
+            req = self.scheduler.peek()
+            if req is None:
+                break
+            pages: List[int] = []
+            if self.pool is not None:
+                need = self.pool.pages_needed(
+                    req.prompt_len + req.max_new_tokens)
+                try:
+                    pages = self.pool.alloc(need)
+                except PagePoolExhausted:
+                    # graceful backpressure: the request stays at the queue
+                    # head; retirements will free pages. Recorded for the
+                    # flight recorder so a post-mortem sees the pressure.
+                    self.scheduler.requeue_head_blocked()
+                    fr = _dev.flight_recorder()
+                    if fr is not None:
+                        fr.record_event(
+                            "serving_admission_blocked",
+                            request_id=req.id, need_pages=need,
+                            free_pages=self.pool.num_free,
+                            batch=self._batch_spec())
+                    break
+            req = self.scheduler.admit(slot)
+            req.admitted_t = time.perf_counter()
+            req.pages = pages
+            bucket = wave_bucket or self._bucket_for(req.prompt_len)
+            done = self._prefill(req, slot, bucket)
+            if done is not None:
+                finished.append(done)
+        return finished
+
+    def _prefill(self, req: Request, slot: int, bucket: int
+                 ) -> Optional[Request]:
+        """Run the per-bucket compiled prefill; returns the request if it
+        finished immediately (EOS first token / max_new_tokens == 1)."""
+        cfg = self.cfg
+        prompt = np.full((bucket,), cfg.pad_id, np.int32)
+        prompt[:req.prompt_len] = req.prompt
+        if cfg.paged:
+            dest_np = self.cache_ops.prompt_dest(req.pages)
+            dest = jnp.asarray(dest_np)
+            self._cache["pt"] = self._cache["pt"].at[slot].set(dest)
+        else:
+            dest = jnp.asarray(self.cache_ops.prompt_dest(slot))
+        exe = self._get_prefill_exe(bucket)
+        t0 = time.perf_counter()
+        self._cache, first_tok, last_logits = exe(
+            self.params, self._cache, dest, jnp.asarray(prompt),
+            jnp.asarray(req.prompt_len, jnp.int32))
+        tok = int(np.asarray(first_tok))
+        _sm.PREFILL_MS.observe((time.perf_counter() - t0) * 1e3)
+        _sm.PREFILL_COUNT.inc()
+        _sm.TOKENS_GENERATED.inc()
+        now = time.perf_counter()
+        req.first_token_t = now
+        _sm.TTFT_MS.observe((now - req.submitted_t) * 1e3)
+        req.tokens_out.append(tok)
+        if cfg.collect_logits:
+            self._captured_logits.setdefault(req.id, []).append(
+                np.asarray(last_logits))
+        if (cfg.eos_id is not None and tok == cfg.eos_id) \
+                or req.max_new_tokens == 1:
+            return self._retire(slot)
+        self._len = self._len.at[slot].set(req.prompt_len)
+        self._tok = self._tok.at[slot].set(tok)
+        self._active = self._active.at[slot].set(True)
+        self._gen = self._gen.at[slot].set(1)
+        self._maxnew = self._maxnew.at[slot].set(req.max_new_tokens)
+        return None
+
+    # -- decode ---------------------------------------------------------------
+    def _decode_dispatch(self) -> List[Request]:
+        fuse = self.cfg.decode_fuse
+        exe = self._get_decode_exe(fuse)
+        t0 = time.perf_counter()
+        try:
+            out = exe(self.params, self._cache, self._len, self._tok,
+                      self._active, self._gen, self._maxnew)
+            if self.cfg.collect_logits:
+                (self._cache, self._len, self._tok, self._active, self._gen,
+                 toks, emitted, fin, logseq) = out
+            else:
+                (self._cache, self._len, self._tok, self._active, self._gen,
+                 toks, emitted, fin) = out
+                logseq = None
+            # one host sync per dispatch: the retire/admit decision needs
+            # the emitted tokens (the serving analog of run_steps' fetch)
+            toks = np.asarray(toks)
+            emitted = np.asarray(emitted)
+            fin = np.asarray(fin)
+        except Exception as e:
+            fr = _dev.flight_recorder()
+            if fr is not None:
+                fr.record_event("serving_inflight_batch", **self._batch_spec())
+            _safe_flight_dump(fr, "serving.decode", e)
+            raise
+        _sm.DECODE_STEP_MS.observe((time.perf_counter() - t0) * 1e3)
+        _sm.DECODE_DISPATCHES.inc()
+        _sm.DECODE_STEPS.inc(fuse)
+        _sm.TOKENS_GENERATED.inc(int(emitted.sum()))
+        finished: List[Request] = []
+        for slot in range(self.cfg.slots):
+            req = self.scheduler.slot_request(slot)
+            if req is None:
+                continue
+            for f in range(fuse):
+                if emitted[f, slot]:
+                    req.tokens_out.append(int(toks[f, slot]))
+                    if logseq is not None:
+                        self._captured_logits.setdefault(req.id, []).append(
+                            np.asarray(logseq[f, slot]))
+                if fin[f, slot]:
+                    finished.append(self._retire(slot))
+                    break
+        return finished
+
+    def _retire(self, slot: int) -> Request:
+        req = self.scheduler.retire(slot)
+        if self.pool is not None and req.pages:
+            self.pool.free(req.pages)
+            req.pages = []
+        req.finished_t = time.perf_counter()
+        _sm.REQUEST_LATENCY_MS.observe(
+            (req.finished_t - req.submitted_t) * 1e3)
+        return req
+
+    def _batch_spec(self) -> dict:
+        """The in-flight batch, host view — what the flight recorder keeps
+        when a decode dispatch fails or admission backpressures."""
+        rows = []
+        for slot in range(self.cfg.slots):
+            req = self.scheduler.slot_request(slot)
+            if req is None:
+                continue
+            rows.append({"slot": slot, "request_id": req.id,
+                         "prompt_len": req.prompt_len,
+                         "generated": len(req.tokens_out),
+                         "max_new_tokens": req.max_new_tokens,
+                         "pages": list(req.pages)})
+        return {"layout": self.cache_ops.layout, "slots": rows,
+                "queue_depth": self.scheduler.queue_depth,
+                "decode_fuse": self.cfg.decode_fuse}
+
+    # -- AOT compilation ------------------------------------------------------
+    def _get_prefill_exe(self, bucket: int):
+        exe = self._prefill_exe.get(bucket)
+        if exe is not None:
+            return exe
+        model, ops, cfg = self.model, self.cache_ops, self.cfg
+
+        def prefill(params, cache, dest, prompt, length):
+            logits, kvs = model.prefill(params, prompt[None], length[None])
+            for i, (k, v) in enumerate(kvs):
+                cache = ops.write_prompt(cache, i, k[0], v[0], dest, length)
+            last = logits[0, length - 1]
+            return cache, jnp.argmax(last).astype(jnp.int32), last
+
+        dest_abs = (jax.ShapeDtypeStruct((ops.pages_per_slot,), jnp.int32)
+                    if cfg.paged else jax.ShapeDtypeStruct((), jnp.int32))
+        exe = aot_compile(
+            prefill,
+            (self.params, self._cache, dest_abs,
+             jax.ShapeDtypeStruct((bucket,), jnp.int32),
+             jax.ShapeDtypeStruct((), jnp.int32)),
+            donate_argnums=(1,))
+        self._prefill_exe[bucket] = exe
+        return exe
+
+    def _get_decode_exe(self, fuse: int):
+        exe = self._decode_exe.get(fuse)
+        if exe is not None:
+            return exe
+        model, ops, cfg = self.model, self.cache_ops, self.cfg
+        eos = -1 if cfg.eos_id is None else cfg.eos_id
+        max_ctx = cfg.max_seq
+        collect = cfg.collect_logits
+
+        def chunk(params, cache, lengths, tokens, active, gen, maxnew):
+            def body(carry, _):
+                cache, ln, tk, ac, gc = carry
+                logits, cache = model.decode(params, cache, ops, tk, ln, ac)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                nxt = jnp.where(ac, nxt, tk)
+                emitted = ac
+                gc = gc + ac
+                ln = ln + ac
+                fin = ac & ((nxt == eos) | (gc >= maxnew) | (ln >= max_ctx))
+                ac = ac & ~fin
+                out = (nxt, emitted, fin, logits) if collect \
+                    else (nxt, emitted, fin)
+                return (cache, ln, nxt, ac, gc), out
+
+            (cache, lengths, tokens, active, gen), outs = jax.lax.scan(
+                body, (cache, lengths, tokens, active, gen), None,
+                length=fuse)
+            return (cache, lengths, tokens, active, gen) + tuple(outs)
+
+        exe = aot_compile(
+            chunk,
+            (self.params, self._cache, self._len, self._tok, self._active,
+             self._gen, self._maxnew),
+            donate_argnums=(1,))
+        self._decode_exe[fuse] = exe
+        return exe
+
+    def warmup(self, buckets: Optional[Sequence[int]] = None) -> None:
+        """Pre-compile the decode chunk + the given (default: all) prefill
+        buckets — with PADDLE_TPU_COMPILE_CACHE set this both warms and
+        persists the executables before traffic arrives."""
+        for b in (buckets or self.cfg.prompt_buckets):
+            self._get_prefill_exe(self._bucket_for(b))
+        self._get_decode_exe(self.cfg.decode_fuse)

@@ -1,0 +1,166 @@
+"""KV-cache layouts for the decode driver: paged block-pool vs contiguous.
+
+Two layouts behind ONE functional interface (`init_state` / `write_token` /
+`write_prompt` / `context`), so the model's decode loop is layout-blind and
+the two paths are bit-comparable:
+
+* :class:`PagedKVCache` — the "Ragged Paged Attention" layout (PAPERS.md):
+  KV rows live in a flat page pool ``[n_layer, num_pages*page_size, H, D]``
+  and each slot owns an ordered page table ``[slots, pages_per_slot]``.
+  Ragged sequence lengths cost only their pages; ``context`` gathers a
+  slot's pages back into logical order (the XLA-gather fallback the issue
+  requires; a Pallas kernel can later fuse the gather into the attention
+  inner loop behind the same interface).
+* :class:`ContiguousKVCache` — the dense reference ``[n_layer, slots,
+  max_ctx, H, D]`` every slot pays ``max_ctx`` for. The parity yardstick
+  (tests/test_serving.py asserts bit-identical tokens/logits) and the
+  padded-baseline cache.
+
+Both write paths scatter with ``mode="drop"`` on out-of-bounds destination
+rows, so inactive slots / padding positions are dropped INSIDE the compiled
+step — no host-side branching, and unwritten rows stay zero in both
+layouts, which is what makes the gathered contexts bit-identical.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["PagedKVCache", "ContiguousKVCache"]
+
+Cache = Dict[str, jnp.ndarray]
+
+
+class _KVCacheBase:
+    """Shared geometry: ``max_ctx`` context positions per slot, over
+    ``n_layer`` layers of ``n_head`` heads of ``d_head`` lanes."""
+
+    layout = "base"
+
+    def __init__(self, n_layer: int, n_head: int, d_head: int, slots: int,
+                 max_ctx: int, dtype=jnp.float32):
+        self.n_layer = int(n_layer)
+        self.n_head = int(n_head)
+        self.d_head = int(d_head)
+        self.slots = int(slots)
+        self.max_ctx = int(max_ctx)
+        self.dtype = jnp.dtype(dtype)
+
+    def cache_bytes(self, state: Cache) -> int:
+        return int(state["k"].nbytes + state["v"].nbytes)
+
+
+class PagedKVCache(_KVCacheBase):
+    layout = "paged"
+
+    def __init__(self, n_layer: int, n_head: int, d_head: int, slots: int,
+                 max_ctx: int, page_size: int, num_pages: int,
+                 dtype=jnp.float32):
+        super().__init__(n_layer, n_head, d_head, slots, max_ctx, dtype)
+        if max_ctx % page_size != 0:
+            raise ValueError("max_ctx=%d must be a multiple of page_size=%d"
+                             % (max_ctx, page_size))
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.pages_per_slot = self.max_ctx // self.page_size
+        self.num_rows = self.num_pages * self.page_size  # flat KV rows
+
+    def init_state(self) -> Cache:
+        shp = (self.n_layer, self.num_rows, self.n_head, self.d_head)
+        return {
+            "k": jnp.zeros(shp, self.dtype),
+            "v": jnp.zeros(shp, self.dtype),
+            # page table: slot -> ordered page ids; rows beyond a slot's
+            # reservation are whatever the allocator last left (reads are
+            # masked by length, writes by the drop scatter)
+            "pt": jnp.zeros((self.slots, self.pages_per_slot), jnp.int32),
+        }
+
+    # -- decode (one token per slot) -----------------------------------------
+    def write_token(self, state: Cache, layer: int, k_new, v_new, pos,
+                    active) -> Cache:
+        """k_new/v_new [B,H,D] written at logical position ``pos[b]`` of
+        slot b; inactive slots dropped via an OOB destination row."""
+        ps = self.page_size
+        pt = state["pt"]
+        b_idx = jnp.arange(pt.shape[0])
+        page = pt[b_idx, pos // ps]
+        dest = page * ps + pos % ps
+        dest = jnp.where(active, dest, self.num_rows)
+        return {
+            **state,
+            "k": state["k"].at[layer, dest].set(k_new, mode="drop"),
+            "v": state["v"].at[layer, dest].set(v_new, mode="drop"),
+        }
+
+    def context(self, state: Cache, layer: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Gather every slot's pages back into logical order:
+        ``[slots, max_ctx, H, D]`` — the XLA-gather paged-attention path."""
+        ps = self.page_size
+        pt = state["pt"]
+        rows = (pt * ps)[:, :, None] + jnp.arange(ps)[None, None, :]
+        rows = rows.reshape(pt.shape[0], self.max_ctx)
+        return state["k"][layer][rows], state["v"][layer][rows]
+
+    # -- prefill (one sequence) ----------------------------------------------
+    def prompt_dest(self, pages) -> np.ndarray:
+        """Host-side: the ``dest`` operand for ``write_prompt`` — a full
+        page-table row (reserved pages first, rest parked on page 0;
+        unused entries are never read or written)."""
+        row = np.zeros(self.pages_per_slot, np.int32)
+        row[:len(pages)] = np.asarray(pages, np.int32)
+        return row
+
+    def write_prompt(self, state: Cache, layer: int, k_new, v_new, dest,
+                     length) -> Cache:
+        """k_new/v_new [S,H,D] for ONE sequence; ``dest`` is its page-table
+        row [pages_per_slot]; positions >= length are dropped."""
+        ps = self.page_size
+        s = k_new.shape[0]
+        j = jnp.arange(s)
+        flat = dest[j // ps] * ps + j % ps
+        flat = jnp.where(j < length, flat, self.num_rows)
+        return {
+            **state,
+            "k": state["k"].at[layer, flat].set(k_new, mode="drop"),
+            "v": state["v"].at[layer, flat].set(v_new, mode="drop"),
+        }
+
+
+class ContiguousKVCache(_KVCacheBase):
+    layout = "contiguous"
+
+    def init_state(self) -> Cache:
+        shp = (self.n_layer, self.slots, self.max_ctx, self.n_head, self.d_head)
+        return {"k": jnp.zeros(shp, self.dtype),
+                "v": jnp.zeros(shp, self.dtype)}
+
+    def write_token(self, state: Cache, layer: int, k_new, v_new, pos,
+                    active) -> Cache:
+        b_idx = jnp.arange(pos.shape[0])
+        pos_c = jnp.where(active, pos, self.max_ctx)  # OOB -> dropped
+        return {
+            **state,
+            "k": state["k"].at[layer, b_idx, pos_c].set(k_new, mode="drop"),
+            "v": state["v"].at[layer, b_idx, pos_c].set(v_new, mode="drop"),
+        }
+
+    def context(self, state: Cache, layer: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        return state["k"][layer], state["v"][layer]
+
+    def prompt_dest(self, slot: int) -> np.int32:
+        return np.int32(slot)
+
+    def write_prompt(self, state: Cache, layer: int, k_new, v_new, dest,
+                     length) -> Cache:
+        s = k_new.shape[0]
+        j = jnp.arange(s)
+        pos_c = jnp.where(j < length, j, self.max_ctx)
+        return {
+            **state,
+            "k": state["k"].at[layer, dest, pos_c].set(k_new, mode="drop"),
+            "v": state["v"].at[layer, dest, pos_c].set(v_new, mode="drop"),
+        }

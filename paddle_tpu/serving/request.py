@@ -1,0 +1,82 @@
+"""Request objects and the serving backpressure error hierarchy.
+
+A :class:`Request` is the unit the multiplexer schedules: it carries the
+prompt, the generation budget, the lifecycle timestamps the latency
+histograms are computed from, and — while running — its slot and reserved
+KV pages. The reference's analog is one AsyncExecutor DataFeed work item
+(SURVEY L4); here the item is an autoregressive generation, not a
+training minibatch.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import List, Optional, Sequence
+
+__all__ = ["Request", "BackpressureError",
+           "QUEUED", "RUNNING", "FINISHED", "REJECTED"]
+
+QUEUED = "queued"
+RUNNING = "running"
+FINISHED = "finished"
+REJECTED = "rejected"
+
+_ids = itertools.count()
+
+
+class BackpressureError(RuntimeError):
+    """The serving stack cannot take more work RIGHT NOW (bounded queue
+    full, or — via the :class:`~.page_pool.PagePoolExhausted` subclass — no
+    KV pages left). Deliberately a distinct type: callers shed or retry;
+    it never signals a crash."""
+
+
+class Request:
+    """One generation request.
+
+    ``prompt`` is a sequence of int token ids; ``max_new_tokens`` bounds
+    generation (the prefill's first sampled token counts toward it).
+    """
+
+    __slots__ = ("id", "prompt", "max_new_tokens", "state", "slot", "pages",
+                 "tokens_out", "submitted_t", "admitted_t", "first_token_t",
+                 "finished_t")
+
+    def __init__(self, prompt: Sequence[int], max_new_tokens: int):
+        if len(prompt) == 0:
+            raise ValueError("Request needs a non-empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.id = next(_ids)
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.state = QUEUED
+        self.slot: Optional[int] = None
+        self.pages: List[int] = []
+        self.tokens_out: List[int] = []
+        self.submitted_t = time.perf_counter()
+        self.admitted_t: Optional[float] = None
+        self.first_token_t: Optional[float] = None
+        self.finished_t: Optional[float] = None
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.prompt)
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.finished_t is None:
+            return None
+        return self.finished_t - self.submitted_t
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_t is None:
+            return None
+        return self.first_token_t - self.submitted_t
+
+    def __repr__(self):
+        return ("Request(id=%d, state=%s, prompt_len=%d, out=%d/%d, slot=%s)"
+                % (self.id, self.state, len(self.prompt),
+                   len(self.tokens_out), self.max_new_tokens, self.slot))

@@ -67,3 +67,80 @@ def test_stablehlo_export_roundtrip(tmp_path, rng):
     # batch polymorphism: different batch size runs without re-export
     out2, = mod.run({"x": xs[:3]})
     np.testing.assert_allclose(out2, want[:3], rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_batch_bucketing_bounds_compile_cache(tmp_path, rng):
+    """Varying client batch sizes must round up to power-of-two buckets:
+    bit-correct sliced outputs, O(log max_batch) compiled specializations
+    instead of one per unique batch."""
+    model_dir, xs, want, _ = _train_and_save(tmp_path, rng)
+    predictor = inference.create_predictor(inference.AnalysisConfig(model_dir))
+    for b in (3, 5, 6, 7):
+        out, = predictor.run([xs[:b]])
+        assert out.shape[0] == b, "padded rows leaked into the output"
+        np.testing.assert_allclose(out, want[:b], rtol=1e-5, atol=1e-6)
+    # 3 -> bucket 4; 5,6,7 -> bucket 8: two specializations, not four
+    assert len(predictor._exe._cache) == 2
+
+    config = inference.AnalysisConfig(model_dir)
+    config.switch_batch_bucketing(False)
+    exact = inference.create_predictor(config)
+    for b in (3, 5, 6, 7):
+        out, = exact.run([xs[:b]])
+        np.testing.assert_allclose(out, want[:b], rtol=1e-5, atol=1e-6)
+    assert len(exact._exe._cache) == 4  # the unbounded-growth failure mode
+
+
+def test_iohandle_reshape_validates_against_staged(tmp_path, rng):
+    model_dir, xs, _, _ = _train_and_save(tmp_path, rng)
+    predictor = inference.create_predictor(inference.AnalysisConfig(model_dir))
+    h = predictor.get_input_handle("x")
+    h.copy_from_cpu(xs[:4])
+    with pytest.raises(ValueError, match="conflicts with already-staged"):
+        h.reshape([8, 8])
+    h.reshape([4, 8])  # matching declaration is fine
+    with pytest.raises(ValueError, match="declared"):
+        h.copy_from_cpu(xs[:2])  # violates the declared shape
+    out_h = predictor.get_output_handle(predictor.get_output_names()[0])
+    with pytest.raises(ValueError, match="input handles"):
+        out_h.reshape([4, 4])
+
+
+def test_iohandle_reuse_across_runs(tmp_path, rng):
+    """run() consumes the staged inputs, so the standard per-iteration
+    reshape()+copy_from_cpu() pattern works at a DIFFERENT batch next
+    iteration instead of colliding with the previous one's shapes."""
+    model_dir, xs, want, _ = _train_and_save(tmp_path, rng)
+    predictor = inference.create_predictor(inference.AnalysisConfig(model_dir))
+    h = predictor.get_input_handle("x")
+    out_name = predictor.get_output_names()[0]
+    for b in (4, 2, 7):
+        h.reshape([b, 8])
+        h.copy_from_cpu(xs[:b])
+        predictor.run()
+        got = predictor.get_output_handle(out_name).copy_to_cpu()
+        assert got.shape[0] == b
+        np.testing.assert_allclose(got, want[:b], rtol=1e-5, atol=1e-6)
+
+
+def test_bucketing_batch_reduced_fetch_stays_exact(tmp_path, rng):
+    """A fetch that reduces over the batch dim must not silently average
+    padded rows in — bucketing falls back to an exact-shape run."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8])
+        out = fluid.layers.fc(x, size=4, act="softmax")
+        m = fluid.layers.mean(out)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    model_dir = str(tmp_path / "redmodel")
+    fluid.io.save_inference_model(model_dir, ["x"], [out, m], exe,
+                                  main_program=main)
+    xs = rng.randn(5, 8).astype("float32")
+    want_out, want_m = exe.run(main.clone(for_test=True), feed={"x": xs},
+                               fetch_list=[out, m])
+    predictor = inference.create_predictor(inference.AnalysisConfig(model_dir))
+    got_out, got_m = predictor.run([xs])
+    assert got_out.shape[0] == 5
+    np.testing.assert_allclose(got_out, want_out, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got_m, want_m, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,262 @@
+"""Serving subsystem tests: scheduler churn invariants, paged-vs-contiguous
+KV-cache bit parity, ragged-vs-padded logit parity, page-pool backpressure
+and flight-recorder capture (ISSUE 6 tentpole coverage)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu import serving
+from paddle_tpu.models import decoder_lm
+from paddle_tpu.serving.page_pool import PagePoolExhausted
+from paddle_tpu.serving.request import Request
+
+_MODEL = None
+
+
+def get_model():
+    """One tiny decoder shared across tests (init cost, not compile cost —
+    each engine still AOT-compiles its own step functions)."""
+    global _MODEL
+    if _MODEL is None:
+        cfg = decoder_lm.DecoderConfig(vocab_size=64, n_layer=2, d_model=32,
+                                       n_head=2, max_seq=64)
+        _MODEL = decoder_lm.DecoderLM(cfg, seed=0)
+    return _MODEL
+
+
+def make_stream(n, rng, max_prompt=16, max_new=8, vocab=64):
+    return [(list(rng.randint(0, vocab, int(rng.randint(3, max_prompt + 1)))),
+             int(rng.randint(2, max_new + 1))) for _ in range(n)]
+
+
+def small_config(**kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("prompt_buckets", (16,))
+    return serving.ServingConfig(**kw)
+
+
+# -- scheduler ---------------------------------------------------------------
+
+def test_scheduler_admit_retire_invariants_under_churn(rng):
+    sched = serving.Scheduler(n_slots=3, max_queue=100)
+    submitted, running, finished = [], {}, []
+    for step in range(200):
+        op = rng.randint(0, 3)
+        if op == 0:
+            r = sched.submit(Request([1, 2], max_new_tokens=2))
+            submitted.append(r)
+        elif op == 1 and sched.peek() is not None and sched.admissible_slots():
+            slot = sched.admissible_slots()[rng.randint(
+                0, len(sched.admissible_slots()))]
+            r = sched.admit(slot)
+            # FIFO: the admitted request is the oldest not-yet-started one
+            expect = next(q for q in submitted
+                          if q not in running.values() and q not in finished)
+            assert r is expect, "admission broke FIFO order"
+            assert r.slot == slot and r.state == "running"
+            running[slot] = r
+        elif op == 2 and running:
+            slot = list(running)[rng.randint(0, len(running))]
+            r = sched.retire(slot)
+            assert r is running.pop(slot)
+            assert r.state == "finished" and r.slot is None
+            finished.append(r)
+        # core invariants, every step
+        assert sched.occupancy == len(running)
+        assert sched.queue_depth == len(submitted) - len(running) - len(finished)
+        assert {r.slot for r in sched.running()} == set(running)
+    # every request is in exactly one place
+    assert len(submitted) == sched.queue_depth + len(running) + len(finished)
+
+
+def test_scheduler_bounded_queue_and_slot_errors():
+    sched = serving.Scheduler(n_slots=1, max_queue=2)
+    sched.submit(Request([1], 1))
+    sched.submit(Request([1], 1))
+    with pytest.raises(serving.BackpressureError):
+        sched.submit(Request([1], 1))
+    sched.admit(0)
+    with pytest.raises(ValueError):
+        sched.admit(0)  # double occupancy
+    sched.retire(0)
+    with pytest.raises(ValueError):
+        sched.retire(0)  # empty slot
+
+
+def test_scheduler_static_mode_admits_only_full_drain():
+    sched = serving.Scheduler(n_slots=2, continuous=False)
+    for _ in range(3):
+        sched.submit(Request([1], 1))
+    assert sched.admissible_slots() == [0, 1]
+    sched.admit(0)
+    # one slot busy -> static policy refuses the other
+    assert sched.admissible_slots() == []
+    sched.retire(0)
+    assert sched.admissible_slots() == [0, 1]
+
+
+# -- page pool ---------------------------------------------------------------
+
+def test_page_pool_accounting_and_atomic_exhaustion():
+    pool = serving.PagePool(num_pages=8, page_size=16)
+    assert pool.pages_needed(1) == 1 and pool.pages_needed(16) == 1
+    assert pool.pages_needed(17) == 2
+    a = pool.alloc(5)
+    assert pool.num_used == 5 and abs(pool.utilization - 5 / 8) < 1e-9
+    with pytest.raises(PagePoolExhausted):
+        pool.alloc(4)  # atomic: nothing taken
+    assert pool.num_free == 3
+    assert isinstance(PagePoolExhausted("x"), serving.BackpressureError)
+    pool.free(a)
+    assert pool.num_used == 0
+    with pytest.raises(ValueError):
+        pool.free([a[0]])  # double free
+    b = pool.alloc(8)
+    assert sorted(b) == list(range(8))
+
+
+# -- decode parity -----------------------------------------------------------
+
+def drive_stream(stream, **cfg_kw):
+    eng = serving.ServingEngine(get_model(), small_config(**cfg_kw))
+    reqs = [eng.submit(p, m) for p, m in stream]
+    done = eng.run()
+    assert len(done) == len(reqs)
+    return eng, reqs
+
+
+def test_paged_vs_contiguous_bit_parity(rng):
+    """The paged gather decode must be BIT-identical to the contiguous
+    reference cache on the same request stream — tokens and logits."""
+    stream = make_stream(8, rng)
+    e1, r1 = drive_stream(stream, paged=True, collect_logits=True)
+    e2, r2 = drive_stream(stream, paged=False, collect_logits=True)
+    for a, b in zip(r1, r2):
+        assert a.tokens_out == b.tokens_out
+        la, lb = e1.captured_logits(a), e2.captured_logits(b)
+        assert len(la) == len(lb) == len(a.tokens_out)
+        for x, y in zip(la, lb):
+            assert np.array_equal(x, y), "paged logits diverged bitwise"
+
+
+def test_ragged_vs_padded_full_recompute_logit_parity(rng):
+    """Bucket-padded prefill + incremental paged decode at mixed lengths
+    must match the O(S^2) full-recompute reference on the unpadded
+    prompt: same greedy tokens, logits to float tolerance."""
+    model = get_model()
+    stream = make_stream(4, rng)
+    eng, reqs = drive_stream(stream, paged=True, collect_logits=True)
+    for req in reqs:
+        toks, logits = decoder_lm.reference_decode(
+            model.params, model.cfg, req.prompt, req.max_new_tokens)
+        assert req.tokens_out == toks
+        for got, want in zip(eng.captured_logits(req), logits):
+            np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_decode_fuse_token_parity(rng):
+    """Fusing k decode steps into one dispatched scan (the run_steps
+    analog) must not change any emitted token."""
+    stream = make_stream(6, rng)
+    _, r1 = drive_stream(stream, decode_fuse=1)
+    _, r4 = drive_stream(stream, decode_fuse=4)
+    for a, b in zip(r1, r4):
+        assert a.tokens_out == b.tokens_out
+
+
+def test_static_wave_mode_drains(rng):
+    stream = make_stream(6, rng)
+    _, reqs = drive_stream(stream, paged=False, continuous=False)
+    assert all(r.state == "finished" for r in reqs)
+    assert all(len(r.tokens_out) == r.max_new_tokens for r in reqs)
+
+
+# -- backpressure + observability --------------------------------------------
+
+def test_pool_exhaustion_queues_not_crashes(rng, monkeypatch, tmp_path):
+    """An undersized page pool must degrade to queueing (admission
+    backpressure) and still drain; the flight recorder captures the
+    pressure event with the in-flight batch."""
+    monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+    from paddle_tpu.monitor import device as _dev, metrics as mx
+
+    blocked0 = mx.snapshot()["serving/admission_blocked_on_pages"]["value"]
+    # 4 slots but pages for only ~1.5 in-flight worst-case requests
+    eng = serving.ServingEngine(get_model(), small_config(num_pages=3))
+    reqs = [eng.submit(list(rng.randint(0, 64, 12)), 8) for _ in range(4)]
+    saw_queued_while_running = False
+    guard = 0
+    while not eng.scheduler.idle():
+        eng.step()
+        if eng.scheduler.queue_depth and eng.scheduler.occupancy:
+            saw_queued_while_running = True
+        guard += 1
+        assert guard < 200, "engine failed to drain under page pressure"
+    assert all(r.state == "finished" for r in reqs)
+    assert all(len(r.tokens_out) == r.max_new_tokens for r in reqs)
+    assert saw_queued_while_running, "pool never actually backpressured"
+    assert mx.snapshot()["serving/admission_blocked_on_pages"]["value"] \
+        > blocked0
+    assert eng.pool.num_used == 0
+    fr = _dev.flight_recorder()
+    events = [e for e in fr._entries
+              if e.get("event") == "serving_admission_blocked"]
+    assert events, "flight recorder missed the backpressure event"
+    assert "batch" in events[-1] and events[-1]["need_pages"] > 0
+
+
+def test_flight_recorder_captures_batch_on_decode_failure(
+        rng, monkeypatch, tmp_path):
+    monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+    eng = serving.ServingEngine(get_model(), small_config())
+    eng.submit(list(rng.randint(0, 64, 8)), 4)
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected decode failure")
+
+    eng._decode_exe[eng.cfg.decode_fuse] = boom
+    with pytest.raises(RuntimeError, match="injected decode failure"):
+        eng.step()
+    dumps = [f for f in os.listdir(str(tmp_path)) if f.startswith("flight_")]
+    assert dumps, "no flight dump written"
+    with open(os.path.join(str(tmp_path), sorted(dumps)[-1])) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "serving.decode"
+    batches = [e for e in doc["entries"]
+               if e.get("event") == "serving_inflight_batch"]
+    assert batches, "dump missing the in-flight batch spec"
+    spec = batches[-1]
+    assert spec["slots"] and spec["slots"][0]["prompt_len"] == 8
+    assert spec["layout"] == "paged"
+
+
+def test_submit_validation_and_immediate_finish(rng):
+    eng = serving.ServingEngine(get_model(), small_config())
+    with pytest.raises(ValueError):
+        eng.submit(list(range(17)), 4)       # beyond largest bucket
+    with pytest.raises(ValueError):
+        eng.submit([1, 2, 3], 62)            # prompt+max_new > max_seq
+    # max_new_tokens=1 finishes at prefill without touching a decode slot
+    req = eng.submit(list(rng.randint(0, 64, 8)), 1)
+    done = eng.run()
+    assert [r.id for r in done] == [req.id]
+    assert len(req.tokens_out) == 1 and req.state == "finished"
+    assert eng.scheduler.idle() and eng.pool.num_used == 0
+
+
+def test_eos_stops_generation(rng):
+    """With eos_id set to the model's (fixed-point) greedy token, requests
+    stop at the first emission instead of running out max_new_tokens."""
+    model = get_model()
+    prompt = list(rng.randint(0, 64, 8))
+    toks, _ = decoder_lm.reference_decode(model.params, model.cfg, prompt, 1)
+    eng = serving.ServingEngine(model, small_config(eos_id=toks[0]))
+    req = eng.submit(prompt, 8)
+    eng.run()
+    assert req.state == "finished"
+    assert len(req.tokens_out) == 1 and req.tokens_out[0] == toks[0]

@@ -192,6 +192,39 @@ def selftest() -> int:
             os.environ.pop("PADDLE_TPU_CHECK_NUMERICS", None)
         else:
             os.environ["PADDLE_TPU_CHECK_NUMERICS"] = prev
+    # 5. serving/* counters: the multiplexer's host-side bookkeeping
+    #    (scheduler + page pool) must feed the registry; the full compiled
+    #    prefill->decode->retire path has its own gate (tools/serve_bench
+    #    --selftest)
+    from paddle_tpu.serving import (PagePool, PagePoolExhausted, Request,
+                                    Scheduler)
+
+    metrics.reset()
+    sched = Scheduler(n_slots=2, max_queue=4)
+    pool = PagePool(num_pages=4, page_size=8)
+    r1 = sched.submit(Request([1, 2, 3], max_new_tokens=4))
+    r2 = sched.submit(Request([4, 5], max_new_tokens=2))
+    r1.pages = pool.alloc(pool.pages_needed(3 + 4))
+    sched.admit(0)
+    try:
+        pool.alloc(99)
+        raise AssertionError("page pool did not backpressure")
+    except PagePoolExhausted:
+        sched.requeue_head_blocked()
+    snap = metrics.snapshot()
+    assert snap["serving/requests_submitted"]["value"] == 2
+    assert snap["serving/requests_admitted"]["value"] == 1
+    assert snap["serving/queue_depth"]["value"] == 1
+    assert snap["serving/slot_occupancy"]["value"] == 1
+    assert snap["serving/page_pool_pages_in_use"]["value"] == 1
+    assert snap["serving/admission_blocked_on_pages"]["value"] == 1
+    pool.free(r1.pages)
+    sched.retire(0)
+    snap = metrics.snapshot()
+    assert snap["serving/requests_retired"]["value"] == 1
+    assert snap["serving/slot_occupancy"]["value"] == 0
+    assert snap["serving/page_pool_utilization"]["value"] == 0
+    assert r2.state == "queued"  # blocked head stays FIFO-first
     metrics.reset()
     print("dump_metrics selftest: OK")
     return 0

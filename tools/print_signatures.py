@@ -44,6 +44,7 @@ MODULES = [
     "paddle_tpu.transpiler",
     "paddle_tpu.contrib",
     "paddle_tpu.inference",
+    "paddle_tpu.serving",
     "paddle_tpu.dataset",
 ]
 
