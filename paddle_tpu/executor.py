@@ -35,8 +35,18 @@ from .core.place import Place, get_device
 from .core.registry import OpContext, get_op_impl
 from .core.scope import Scope, global_scope
 from .monitor import GRAD_NORM_VAR, device as _dev, metrics as _mx, tracer as _tr
+from .reliability import faults as _faults
 
-__all__ = ["Executor", "FetchHandle", "TraceContext", "aot_compile"]
+__all__ = ["Executor", "FeedError", "FetchHandle", "TraceContext",
+           "aot_compile"]
+
+
+class FeedError(RuntimeError):
+    """The feed source raised while ``run_steps`` assembled a fused chunk.
+
+    Typed (and flight-recorded by the run_steps crash path) so a data-side
+    failure names the global step and the position inside the chunk instead
+    of surfacing as a bare stack from ``lax.scan`` input prep."""
 
 # Instruments are module-level handles: looked up once, so the per-run cost
 # with metrics ON is a few lock+add ops, and with metrics OFF a single
@@ -318,6 +328,7 @@ def _timed_lower_compile(jitted_fn, args):
     """(lowered, executable) with the compile wall time routed to the
     executor/compile_time_ms histogram — the one AOT timing convention
     shared by Executor.prepare and aot_compile."""
+    _faults.fire("executor.compile")  # chaos drills: injected compile failure
     t0 = time.perf_counter()
     lowered = jitted_fn.lower(*args)
     aot = lowered.compile()
@@ -965,6 +976,9 @@ class Executor:
                 extra={"optimized": _dev.program_fingerprint(program)})
         t_step = time.perf_counter() if mx_on else 0.0
         try:
+            spec = _faults.fire("executor.dispatch")
+            if spec is not None and spec.kind == "nan":
+                feeds = _faults.poison_feeds(feeds)
             if tr_on:
                 with _tr.span("executor/compile_and_step" if was_miss
                               else "executor/step", cat="executor"):
@@ -1376,7 +1390,25 @@ class Executor:
                             f = next(feed_iter)
                         except StopIteration:
                             break
-                    sig, f = _shape_sig(f)
+                        except Exception as e:
+                            # typed data-side error: names the step index
+                            # within the chunk (and the global step), and
+                            # rides the outer except into the flight dump
+                            raise FeedError(
+                                "run_steps(): feed source raised at global "
+                                "step %d (position %d of the current "
+                                "%d-step chunk): %s: %s"
+                                % (consumed + len(chunk), len(chunk), want,
+                                   type(e).__name__, e)) from e
+                    try:
+                        sig, f = _shape_sig(f)
+                    except Exception as e:
+                        raise FeedError(
+                            "run_steps(): feed for global step %d (position "
+                            "%d of the current %d-step chunk) could not be "
+                            "converted to arrays: %s: %s"
+                            % (consumed + len(chunk), len(chunk), want,
+                               type(e).__name__, e)) from e
                     if chunk and sig != sig0:
                         # shape boundary (the epoch's final partial batch):
                         # cut the chunk here — stacking needs uniform
@@ -1435,6 +1467,9 @@ class Executor:
                         fetch_names,
                         extra={"chunk_steps": n,
                                "optimized": _dev.program_fingerprint(program)})
+                spec = _faults.fire("executor.dispatch")
+                if spec is not None and spec.kind == "nan":
+                    stacked = _faults.poison_feeds(stacked)
                 t0 = time.perf_counter() if mx_on else 0.0
                 if tr_on:
                     with _tr.span("executor/run_steps_chunk", cat="executor",

@@ -261,6 +261,32 @@ class CheckpointConfig:
         self.step_interval = step_interval
 
 
+def _fsync_path(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_tree(dirpath):
+    """fsync every file under ``dirpath`` and the directories themselves —
+    the durability barrier a host crash between write and the
+    ``os.replace`` publish requires: without it a _SUCCESS-marked
+    checkpoint can survive the rename while its tensor payloads are still
+    unflushed page cache (a torn checkpoint that LOOKS complete).
+    Best-effort on filesystems without fsync semantics."""
+    try:
+        for root, dirs, files in os.walk(dirpath):
+            for name in files:
+                _fsync_path(os.path.join(root, name))
+            for name in dirs:
+                _fsync_path(os.path.join(root, name))
+        _fsync_path(dirpath)
+    except OSError:
+        pass
+
+
 def _checkpoint_serials(checkpoint_dir):
     if not os.path.isdir(checkpoint_dir):
         return []
@@ -276,49 +302,107 @@ def _checkpoint_serials(checkpoint_dir):
 
 def save_checkpoint(executor, checkpoint_dir, main_program=None,
                     trainer_id=0, trainer_args=None, max_num_checkpoints=3):
-    """Write a new serial-numbered checkpoint of all persistables, atomically
-    (tmp dir + _SUCCESS marker), then rotate old ones. ``trainer_args``
+    """Write a new serial-numbered checkpoint of all persistables, durably
+    and atomically: param files + trainer_args + _SUCCESS marker land in a
+    tmp dir, everything is fsync'd (files AND directory — a host crash
+    between write and publish must not leave a _SUCCESS-marked torn
+    checkpoint), then one ``os.replace`` publishes the serial. Rotation is
+    performed ONLY by ``trainer_id == 0`` so concurrent multi-trainer
+    savers can't race-delete each other's serials. ``trainer_args``
     (e.g. {'step': 123, 'epoch': 4}) are stored for resume bookkeeping."""
     serials = _checkpoint_serials(checkpoint_dir)
     serial = (serials[-1] + 1) if serials else 0
     final = os.path.join(checkpoint_dir, _CKPT_PREFIX + str(serial))
-    tmp = final + ".tmp"
+    # the staging dir is per-trainer (and per-process): two trainers that
+    # race to the same serial stage into DIFFERENT dirs, so neither can
+    # rmtree the other's half-written payload or publish a mixed dir
+    tmp = "%s.tmp.%d.%d" % (final, trainer_id, os.getpid())
     if os.path.isdir(tmp):
         import shutil
 
         shutil.rmtree(tmp)
     save_persistables(executor, tmp, main_program)
+    # chaos drills: an injected fault HERE leaves an unpublished .tmp dir —
+    # exactly the torn-write state load_checkpoint must skip
+    from .reliability import faults as _faults
+
+    _faults.fire("io.save_checkpoint")
     with open(os.path.join(tmp, "trainer_args.json"), "w") as f:
         json.dump({"trainer_id": trainer_id, **(trainer_args or {})}, f)
     with open(os.path.join(tmp, _SUCCESS_MARK), "w") as f:
         f.write("ok")
-    os.replace(tmp, final)
-    # rotate
-    serials.append(serial)
-    import shutil
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_tree(tmp)
+    try:
+        os.replace(tmp, final)
+    except OSError:
+        # lost the publish race: a concurrent trainer already published
+        # this serial (same persistable state — both savers hold replicas).
+        # Drop our staging copy; the peer's checkpoint serves the resume.
+        import shutil
 
-    for old in serials[:-max_num_checkpoints] if max_num_checkpoints > 0 else []:
-        shutil.rmtree(os.path.join(checkpoint_dir, _CKPT_PREFIX + str(old)),
-                      ignore_errors=True)
+        shutil.rmtree(tmp, ignore_errors=True)
+        if not os.path.isfile(os.path.join(final, _SUCCESS_MARK)):
+            raise
+        return serial
+    # publish barrier: the rename itself must survive the crash
+    try:
+        _fsync_path(checkpoint_dir)
+    except OSError:
+        pass
+    if trainer_id == 0:
+        serials.append(serial)
+        import shutil
+
+        for old in (serials[:-max_num_checkpoints]
+                    if max_num_checkpoints > 0 else []):
+            shutil.rmtree(
+                os.path.join(checkpoint_dir, _CKPT_PREFIX + str(old)),
+                ignore_errors=True)
     return serial
 
 
 def load_checkpoint(executor, checkpoint_dir, main_program=None, serial=None):
     """Restore the latest complete checkpoint (or ``serial``); returns the
     stored trainer_args dict, or None if no valid checkpoint exists — the
-    auto-resume contract: call at startup, train from scratch on None."""
+    auto-resume contract: call at startup, train from scratch on None.
+
+    Torn-restore fallback: a _SUCCESS-marked checkpoint whose payload is
+    unreadable (truncated tensor file, disk corruption) is logged and
+    SKIPPED in favour of the previous serial instead of raising
+    mid-restore with the scope half-loaded — the fallback serial's full
+    ``load_persistables`` overwrites any partially-set vars."""
+    from .log import vlog
+
     serials = _checkpoint_serials(checkpoint_dir)
     candidates = [serial] if serial is not None else list(reversed(serials))
+    last_exc = None
     for s in candidates:
         d = os.path.join(checkpoint_dir, _CKPT_PREFIX + str(s))
         if not os.path.isfile(os.path.join(d, _SUCCESS_MARK)):
             continue  # partial write (preempted mid-save) — skip
-        load_persistables(executor, d, main_program)
+        try:
+            load_persistables(executor, d, main_program)
+        except Exception as e:
+            last_exc = e
+            vlog(0, "load_checkpoint: serial %d is _SUCCESS-marked but "
+                    "unreadable (%s: %s); falling back to the previous "
+                    "serial", s, type(e).__name__, e)
+            continue
         try:
             with open(os.path.join(d, "trainer_args.json")) as f:
                 return json.load(f)
         except FileNotFoundError:
             return {}
+    if last_exc is not None:
+        # every _SUCCESS candidate was torn: surface the corruption rather
+        # than silently training from scratch over a half-loaded scope
+        raise RuntimeError(
+            "load_checkpoint: no readable checkpoint in %r (all "
+            "_SUCCESS-marked serials failed to restore; last error: %s: %s)"
+            % (checkpoint_dir, type(last_exc).__name__, last_exc)
+        ) from last_exc
     return None
 
 

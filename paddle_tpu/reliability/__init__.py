@@ -1,0 +1,52 @@
+"""paddle_tpu.reliability — fault injection + crash-safe training/serving.
+
+The production-traffic posture layer (ROADMAP item 4b): the PR 5 flight
+recorder can *describe* a crash and the PR 6 serving stack can *count* one;
+this package makes the system *survive* them —
+
+* :mod:`~.faults`: a deterministic, seedable fault-injection framework
+  (``PADDLE_TPU_FAULT_PLAN`` env grammar or programmatic
+  :class:`~.faults.FaultPlan`) arming typed faults — preemption, transient
+  dispatch/compile failure, RESOURCE_EXHAUSTED, injected NaN, latency
+  spikes, pool exhaustion — at the chokepoints that already exist
+  (Executor dispatch, the AOT compile path, the serving decode dispatch,
+  ``io.save_checkpoint``, ``PagePool.alloc``), plus :func:`~.faults.classify`,
+  the one transient/fatal/preemption retry-policy oracle.
+* :mod:`~.supervisor`: :func:`~.supervisor.run_supervised` — the
+  preemption-aware training driver: SIGTERM/SIGINT finish the in-flight
+  fused chunk, write a rotating checkpoint and exit with
+  :data:`~.supervisor.EXIT_PREEMPTED`; periodic auto-checkpoint;
+  auto-resume with the per-step RNG counter rewound so the resumed loss
+  trajectory is bit-identical to an uninterrupted run; bounded
+  retry-with-backoff for transient faults.
+
+Serving-side recovery (per-request deadlines, decode-failure batch
+recovery, ``engine.health()``) lives in :mod:`paddle_tpu.serving` and uses
+:func:`~.faults.classify` for its retry ladder. Drills:
+``python -m tools.chaos_drill --selftest`` (ROADMAP smoke gate) and the
+multi-process kill/resume drill in ``tests/test_dist_multiprocess.py``.
+"""
+
+from . import faults  # noqa: F401
+from .faults import (  # noqa: F401
+    FaultPlan, FaultSpec, InjectedFault, TransientFault,
+    InjectedResourceExhausted, PreemptionRequested, classify,
+)
+
+__all__ = [
+    "faults", "FaultPlan", "FaultSpec", "InjectedFault", "TransientFault",
+    "InjectedResourceExhausted", "PreemptionRequested", "classify",
+    "EXIT_PREEMPTED", "SupervisorResult", "run_supervised",
+]
+
+_SUPERVISOR_NAMES = ("EXIT_PREEMPTED", "SupervisorResult", "run_supervised")
+
+
+def __getattr__(name):
+    # supervisor imports the Executor/io stack; loading it lazily keeps
+    # `executor -> reliability.faults` import-cycle-free
+    if name in _SUPERVISOR_NAMES:
+        from . import supervisor as _sup
+
+        return getattr(_sup, name)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

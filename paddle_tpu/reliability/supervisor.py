@@ -1,0 +1,220 @@
+"""Preemption-aware training supervisor: checkpoint, resume, retry.
+
+:func:`run_supervised` wraps ``Executor.run_steps`` with the production
+lifecycle the bare driver lacks:
+
+* **Preemption**: SIGTERM/SIGINT set a flag; the in-flight fused chunk
+  finishes, a rotating checkpoint is written (``io.save_checkpoint``), and
+  the process exits with :data:`EXIT_PREEMPTED` (or the call returns with
+  ``result.preempted`` when ``exit_on_preempt=False``) — the contract a
+  cloud scheduler's preemption notice expects.
+* **Auto-checkpoint**: every ``checkpoint_every_steps`` steps and/or
+  ``checkpoint_every_s`` seconds.
+* **Auto-resume**: on entry the latest complete checkpoint is restored
+  (``io.load_checkpoint``), the per-step RNG counter is rewound to the
+  checkpointed step (so dropout masks and every other per-step stream
+  continue bit-identically), and the step offset is handed back to the
+  caller's ``feed_source`` so the data stream resumes in place — the
+  kill/resume drill asserts the resumed loss trajectory is bit-identical
+  to an uninterrupted run.
+* **Retry**: a failed chunk is classified (:func:`~.faults.classify`);
+  transient failures retry with exponential backoff up to ``max_retries``
+  (the RNG step counter is rewound first, so a retried chunk replays the
+  exact streams of the failed attempt); fatal failures record a
+  supervisor event in the flight recorder and re-raise.
+
+The feed contract: ``feed_source(start_step)`` returns an iterator yielding
+one feed dict per step **starting at global step** ``start_step`` — the
+supervisor materializes each fused chunk before dispatching it, so a
+transient failure can replay the chunk without re-pulling data.
+"""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+from ..monitor import device as _dev, metrics as _mx
+from . import faults as _faults
+
+__all__ = ["EXIT_PREEMPTED", "SupervisorResult", "run_supervised"]
+
+#: Marked exit code for a preemption-triggered checkpoint-and-exit — the
+#: restart policy treats it as "resume me", unlike a crash code.
+EXIT_PREEMPTED = 42
+
+_m_preempt = _mx.counter("reliability/preemptions",
+                         help="preemption notices honored (checkpoint+exit)")
+_m_ckpt = _mx.counter("reliability/checkpoints_written",
+                      help="rotating checkpoints written by the supervisor")
+_m_resume = _mx.counter("reliability/resumes",
+                        help="supervised runs that restored a checkpoint")
+_m_retry = _mx.counter("reliability/retries",
+                       help="transient chunk failures absorbed by retry")
+
+
+class SupervisorResult:
+    """Outcome of one :func:`run_supervised` invocation."""
+
+    __slots__ = ("steps_done", "start_step", "resumed", "preempted",
+                 "losses", "checkpoints_written", "retries", "last_serial")
+
+    def __init__(self):
+        self.steps_done = 0        # global step index reached
+        self.start_step = 0        # where this invocation began (resume point)
+        self.resumed = False
+        self.preempted = False
+        self.losses: List[Any] = []  # one fetch row per step run HERE
+        self.checkpoints_written = 0
+        self.retries = 0
+        self.last_serial: Optional[int] = None
+
+    def __repr__(self):
+        return ("SupervisorResult(steps=%d from %d, resumed=%s, preempted=%s,"
+                " ckpts=%d, retries=%d)"
+                % (self.steps_done, self.start_step, self.resumed,
+                   self.preempted, self.checkpoints_written, self.retries))
+
+
+def run_supervised(
+    exe,
+    program,
+    feed_source: Callable[[int], Any],
+    total_steps: int,
+    fetch_list: Optional[Sequence] = None,
+    *,
+    checkpoint_dir: str,
+    fetch_every: int = 1,
+    checkpoint_every_steps: int = 0,
+    checkpoint_every_s: float = 0.0,
+    max_retries: int = 3,
+    backoff_s: float = 0.05,
+    trainer_id: int = 0,
+    max_num_checkpoints: int = 3,
+    exit_on_preempt: bool = True,
+    install_signal_handlers: bool = True,
+) -> SupervisorResult:
+    """Drive ``total_steps`` training steps with preemption handling,
+    rotating checkpoints, auto-resume and bounded transient retry.
+
+    ``feed_source(start_step)`` must return an iterator of per-step feed
+    dicts beginning at ``start_step``. Fetches (``fetch_list``) come back
+    in ``result.losses``, one numpy row per step executed by THIS call
+    (resumed steps before ``start_step`` belong to the previous life).
+    """
+    from .. import io as _io
+
+    res = SupervisorResult()
+    args = _io.load_checkpoint(exe, checkpoint_dir, program)
+    if args is not None:
+        res.resumed = True
+        res.start_step = int(args.get("step", 0))
+        _m_resume.inc()
+    start = res.start_step
+    # Rewind the per-step RNG counter to the resume point: the compiled step
+    # folds this counter into every stochastic op's key, so restoring it is
+    # what makes the resumed trajectory bit-identical, dropout included.
+    program._tpu_step_counter = start
+    res.steps_done = start
+
+    preempt_flag = threading.Event()
+    installed = []
+    if install_signal_handlers and \
+            threading.current_thread() is threading.main_thread():
+        def _on_signal(signum, frame):
+            preempt_flag.set()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            installed.append((sig, signal.signal(sig, _on_signal)))
+
+    def _checkpoint(step: int) -> None:
+        serial = _io.save_checkpoint(
+            exe, checkpoint_dir, program, trainer_id=trainer_id,
+            trainer_args={"step": step},
+            max_num_checkpoints=max_num_checkpoints)
+        res.last_serial = serial
+        res.checkpoints_written += 1
+        _m_ckpt.inc()
+
+    it = iter(feed_source(start))
+    k = max(1, int(fetch_every))
+    last_ckpt_step = start
+    last_ckpt_t = time.monotonic()
+    fr = _dev.flight_recorder()
+    try:
+        while res.steps_done < total_steps and not preempt_flag.is_set():
+            want = min(k, total_steps - res.steps_done)
+            chunk = []
+            while len(chunk) < want:
+                try:
+                    chunk.append(next(it))
+                except StopIteration:
+                    break
+            if not chunk:
+                break  # feed source exhausted before total_steps
+
+            counter0 = getattr(program, "_tpu_step_counter", res.steps_done)
+            attempt = 0
+            while True:
+                try:
+                    rows = exe.run_steps(
+                        program, iter(chunk), steps=len(chunk),
+                        fetch_list=fetch_list, fetch_every=len(chunk))
+                    break
+                except Exception as e:
+                    kind = _faults.classify(e)
+                    if kind == "transient" and attempt < max_retries:
+                        attempt += 1
+                        res.retries += 1
+                        _m_retry.inc()
+                        # rewind the RNG counter a partially-dispatched
+                        # chunk may have advanced: the retry must replay
+                        # the SAME per-step streams
+                        program._tpu_step_counter = counter0
+                        if backoff_s:
+                            time.sleep(backoff_s * (2 ** (attempt - 1)))
+                        continue
+                    if fr is None:
+                        fr = _dev.flight_recorder()
+                    if fr is not None:
+                        fr.record_event(
+                            "supervisor_fatal", step=res.steps_done,
+                            classified=kind, attempts=attempt,
+                            error="%s: %s" % (type(e).__name__, e))
+                    raise
+            res.losses.extend(rows)
+            res.steps_done += len(chunk)
+
+            due = False
+            if checkpoint_every_steps and \
+                    res.steps_done - last_ckpt_step >= checkpoint_every_steps:
+                due = True
+            if checkpoint_every_s and \
+                    time.monotonic() - last_ckpt_t >= checkpoint_every_s:
+                due = True
+            if due and res.steps_done < total_steps:
+                _checkpoint(res.steps_done)
+                last_ckpt_step = res.steps_done
+                last_ckpt_t = time.monotonic()
+
+        if preempt_flag.is_set() and res.steps_done < total_steps:
+            res.preempted = True
+            _m_preempt.inc()
+            if res.steps_done != last_ckpt_step:
+                # skip the write when the periodic checkpoint already
+                # covered this exact step — no duplicate serial
+                _checkpoint(res.steps_done)
+            if fr is not None:
+                fr.record_event("supervisor_preempted",
+                                step=res.steps_done,
+                                serial=res.last_serial)
+    finally:
+        for sig, prev in installed:
+            signal.signal(sig, prev)
+
+    if res.preempted and exit_on_preempt:
+        sys.exit(EXIT_PREEMPTED)
+    return res

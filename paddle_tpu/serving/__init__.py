@@ -35,7 +35,8 @@ padded static baseline), ``python -m tools.serve_bench --selftest``.
 from .engine import ServingConfig, ServingEngine  # noqa: F401
 from .kv_cache import ContiguousKVCache, PagedKVCache  # noqa: F401
 from .page_pool import PagePool, PagePoolExhausted  # noqa: F401
-from .request import BackpressureError, Request  # noqa: F401
+from .request import (  # noqa: F401
+    FAILED, FINISHED, QUEUED, RUNNING, TIMEOUT, BackpressureError, Request)
 from .scheduler import Scheduler  # noqa: F401
 
 __all__ = [
@@ -43,4 +44,5 @@ __all__ = [
     "PagedKVCache", "ContiguousKVCache",
     "PagePool", "PagePoolExhausted",
     "Scheduler", "Request", "BackpressureError",
+    "QUEUED", "RUNNING", "FINISHED", "TIMEOUT", "FAILED",
 ]

@@ -32,10 +32,11 @@ import numpy as np
 
 from ..executor import _safe_flight_dump, aot_compile
 from ..monitor import device as _dev
+from ..reliability import faults as _faults
 from . import metrics as _sm
 from .kv_cache import ContiguousKVCache, PagedKVCache
 from .page_pool import PagePool, PagePoolExhausted
-from .request import Request
+from .request import FAILED, FINISHED, TIMEOUT, Request
 from .scheduler import Scheduler
 
 __all__ = ["ServingConfig", "ServingEngine"]
@@ -63,6 +64,13 @@ class ServingConfig:
     ``continuous=False`` degrades to the padded static wave-drain baseline;
     ``paged=False`` swaps in the contiguous reference cache. ``eos_id=None``
     disables EOS stopping (generation runs to ``max_new_tokens``).
+
+    Failure policy: ``decode_retries`` bounds in-place retries of a decode
+    dispatch whose failure classifies as transient
+    (:func:`paddle_tpu.reliability.faults.classify`); past the budget — or
+    on a fatal failure — the in-flight batch is FAILED, its pages return to
+    the pool, and the engine keeps serving the queue. ``fail_fast=True``
+    restores the old raise-through behavior (debugging).
     """
 
     def __init__(self, slots: int = 8, page_size: int = 16,
@@ -71,7 +79,8 @@ class ServingConfig:
                  max_queue: int = 1024, eos_id: Optional[int] = None,
                  decode_fuse: int = 1, paged: bool = True,
                  continuous: bool = True, collect_logits: bool = False,
-                 pad_id: int = 0):
+                 pad_id: int = 0, decode_retries: int = 2,
+                 fail_fast: bool = False):
         if max_seq % page_size != 0:
             raise ValueError("max_seq=%d must be a multiple of page_size=%d"
                              % (max_seq, page_size))
@@ -93,6 +102,8 @@ class ServingConfig:
         self.continuous = bool(continuous)
         self.collect_logits = bool(collect_logits)
         self.pad_id = int(pad_id)
+        self.decode_retries = max(0, int(decode_retries))
+        self.fail_fast = bool(fail_fast)
 
 
 class ServingEngine:
@@ -140,13 +151,20 @@ class ServingEngine:
         self._prefill_exe: Dict[int, Any] = {}   # bucket -> AOT executable
         self._decode_exe: Dict[int, Any] = {}    # fuse length -> executable
         self._captured_logits: Dict[int, List[np.ndarray]] = {}
+        self._consecutive_failures = 0
+        self._faults_absorbed = 0
+        self._last_error: Optional[str] = None
 
     # -- public API -----------------------------------------------------------
-    def submit(self, prompt: Sequence[int], max_new_tokens: int) -> Request:
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               deadline_s: Optional[float] = None) -> Request:
         """Queue a request. Raises ``ValueError`` for a request that can
         NEVER be served at this geometry, and ``BackpressureError`` when
-        the bounded queue is full (shed/retry — transient)."""
-        req = Request(prompt, max_new_tokens)
+        the bounded queue is full (shed/retry — transient). ``deadline_s``
+        bounds the request's wall-clock life from submission: past it the
+        request is retired with TIMEOUT status (queued or running) so it
+        stops pinning a slot and KV pages."""
+        req = Request(prompt, max_new_tokens, deadline_s=deadline_s)
         if req.prompt_len > self.cfg.prompt_buckets[-1]:
             raise ValueError(
                 "prompt length %d exceeds the largest prefill bucket %d"
@@ -164,10 +182,12 @@ class ServingEngine:
         return self.scheduler.submit(req)
 
     def step(self) -> List[Request]:
-        """One multiplexer cycle: retire/admit into free slots, prefill the
-        admissions, then one fused decode dispatch. Returns requests that
-        finished during the cycle."""
-        finished = self._admit()
+        """One multiplexer cycle: expire deadlines, retire/admit into free
+        slots, prefill the admissions, then one fused decode dispatch.
+        Returns requests that reached a terminal state during the cycle
+        (FINISHED, TIMEOUT or FAILED — check ``req.state``)."""
+        finished = self._expire_deadlines()
+        finished.extend(self._admit())
         if self.scheduler.occupancy:
             finished.extend(self._decode_dispatch())
         return finished
@@ -204,6 +224,33 @@ class ServingEngine:
             out["pages_in_use"] = self.pool.num_used
             out["page_pool_utilization"] = round(self.pool.utilization, 4)
         return out
+
+    def health(self) -> dict:
+        """Liveness/degradation snapshot for an external health checker:
+        ``status`` is ``"ok"`` until a decode failure is absorbed and back
+        to ``"ok"`` after the next clean dispatch (``"degraded"`` in
+        between). Counters are lifetime totals for THIS engine."""
+        out = {
+            "status": "degraded" if self._consecutive_failures else "ok",
+            "queued": self.scheduler.queue_depth,
+            "running": self.scheduler.occupancy,
+            "consecutive_failures": self._consecutive_failures,
+            "faults_absorbed": self._faults_absorbed,
+            "last_error": self._last_error,
+            "page_accounting_ok": self.page_accounting_ok(),
+        }
+        if self.pool is not None:
+            out["pages_free"] = self.pool.num_free
+            out["pages_total"] = self.pool.num_pages
+        return out
+
+    def page_accounting_ok(self) -> bool:
+        """The no-leak invariant every retirement path must preserve: pages
+        the pool counts as used == pages held by running requests."""
+        if self.pool is None:
+            return True
+        held = sum(len(r.pages) for r in self.scheduler.running())
+        return self.pool.num_used == held
 
     # -- admission + prefill --------------------------------------------------
     def _bucket_for(self, n: int) -> int:
@@ -295,31 +342,79 @@ class ServingEngine:
         return None
 
     # -- decode ---------------------------------------------------------------
+    def _cache_lost(self) -> bool:
+        """True when a failed dispatch already consumed the donated cache
+        buffers (``donate_argnums=(1,)``) — retrying would feed deleted
+        arrays, so recovery must re-init the cache instead."""
+        lost = False
+
+        def probe(v):
+            nonlocal lost
+            deleted = getattr(v, "is_deleted", None)
+            if deleted is not None and deleted():
+                lost = True
+
+        jax.tree_util.tree_map(probe, self._cache)
+        return lost
+
     def _decode_dispatch(self) -> List[Request]:
+        """One fused decode dispatch with the recovery ladder: transient
+        failures retry in place (bounded by ``decode_retries``); a failure
+        that exhausts the budget — or classifies fatal — FAILS the
+        in-flight batch (pages reclaimed, requests marked FAILED, device
+        slot state reset) and the engine keeps serving the queue. The
+        flight recorder captures the batch spec either way."""
         fuse = self.cfg.decode_fuse
         exe = self._get_decode_exe(fuse)
         t0 = time.perf_counter()
-        try:
-            out = exe(self.params, self._cache, self._len, self._tok,
-                      self._active, self._gen, self._maxnew)
-            if self.cfg.collect_logits:
-                (self._cache, self._len, self._tok, self._active, self._gen,
-                 toks, emitted, fin, logseq) = out
-            else:
-                (self._cache, self._len, self._tok, self._active, self._gen,
-                 toks, emitted, fin) = out
-                logseq = None
-            # one host sync per dispatch: the retire/admit decision needs
-            # the emitted tokens (the serving analog of run_steps' fetch)
-            toks = np.asarray(toks)
-            emitted = np.asarray(emitted)
-            fin = np.asarray(fin)
-        except Exception as e:
-            fr = _dev.flight_recorder()
-            if fr is not None:
-                fr.record_event("serving_inflight_batch", **self._batch_spec())
-            _safe_flight_dump(fr, "serving.decode", e)
-            raise
+        attempt = 0
+        # Pre-dispatch snapshot: on an async backend a failed dispatch often
+        # surfaces at host materialization (np.asarray below), AFTER the
+        # self._* slots were reassigned to the failed step's outputs — a
+        # retry from those half-advanced values would double-step every
+        # in-flight request. The failure path always rolls back to this
+        # snapshot first (the donated cache may be gone; _cache_lost() on
+        # the restored ref detects that and downgrades retry to recovery).
+        snap = (self._cache, self._len, self._tok, self._active, self._gen)
+        while True:
+            try:
+                spec = _faults.fire("serving.decode")  # chaos drills
+                if spec is not None and spec.kind == "exhausted":
+                    raise PagePoolExhausted(
+                        "injected pool exhaustion at serving.decode")
+                out = exe(self.params, self._cache, self._len, self._tok,
+                          self._active, self._gen, self._maxnew)
+                if self.cfg.collect_logits:
+                    (self._cache, self._len, self._tok, self._active,
+                     self._gen, toks, emitted, fin, logseq) = out
+                else:
+                    (self._cache, self._len, self._tok, self._active,
+                     self._gen, toks, emitted, fin) = out
+                    logseq = None
+                # one host sync per dispatch: the retire/admit decision needs
+                # the emitted tokens (the serving analog of run_steps' fetch)
+                toks = np.asarray(toks)
+                emitted = np.asarray(emitted)
+                fin = np.asarray(fin)
+                break
+            except Exception as e:
+                (self._cache, self._len, self._tok, self._active,
+                 self._gen) = snap
+                if (_faults.classify(e) == "transient"
+                        and attempt < self.cfg.decode_retries
+                        and not self._cache_lost()):
+                    attempt += 1
+                    _sm.RETRIES.inc()
+                    continue
+                fr = _dev.flight_recorder()
+                if fr is not None:
+                    fr.record_event("serving_inflight_batch",
+                                    **self._batch_spec())
+                _safe_flight_dump(fr, "serving.decode", e)
+                if self.cfg.fail_fast:
+                    raise
+                return self._fail_inflight_batch(e)
+        self._consecutive_failures = 0
         _sm.DECODE_STEP_MS.observe((time.perf_counter() - t0) * 1e3)
         _sm.DECODE_DISPATCHES.inc()
         _sm.DECODE_STEPS.inc(fuse)
@@ -340,15 +435,74 @@ class ServingEngine:
                     break
         return finished
 
-    def _retire(self, slot: int) -> Request:
-        req = self.scheduler.retire(slot)
+    def _retire(self, slot: int, state: str = FINISHED,
+                clear_slot: bool = True) -> Request:
+        """EVERY slot-vacating path funnels through here — EOS/max_new
+        (FINISHED), deadline (TIMEOUT), decode failure (FAILED) — so page
+        reclamation can't be forgotten on a new path. ``clear_slot=False``
+        is for callers about to reset ALL device slot state wholesale
+        (``_fail_inflight_batch``) — no point in per-slot updates first."""
+        req = self.scheduler.retire(slot, state)
         if self.pool is not None and req.pages:
             self.pool.free(req.pages)
             req.pages = []
         req.finished_t = time.perf_counter()
-        _sm.REQUEST_LATENCY_MS.observe(
-            (req.finished_t - req.submitted_t) * 1e3)
+        if state == FINISHED:
+            _sm.REQUEST_LATENCY_MS.observe(
+                (req.finished_t - req.submitted_t) * 1e3)
+        elif state == TIMEOUT:
+            _sm.TIMEOUTS.inc()
+        elif state == FAILED:
+            _sm.REQUESTS_FAILED.inc()
+        if state != FINISHED and clear_slot:
+            # the decode loop only deactivates slots it finished itself;
+            # an out-of-band retirement must clear the device-side flag or
+            # the next dispatch decodes a ghost
+            self._active = self._active.at[slot].set(False)
         return req
+
+    def _expire_deadlines(self) -> List[Request]:
+        """Retire requests past their deadline — queued ones leave the
+        queue (no pages to reclaim), running ones vacate slot + pages."""
+        now = time.perf_counter()
+        out: List[Request] = []
+        for req in self.scheduler.drop_expired(now):
+            req.finished_t = now
+            _sm.TIMEOUTS.inc()
+            out.append(req)
+        for slot in range(self.cfg.slots):
+            req = self.scheduler.slot_request(slot)
+            if req is not None and req.expired(now):
+                out.append(self._retire(slot, state=TIMEOUT))
+        return out
+
+    def _fail_inflight_batch(self, exc: BaseException) -> List[Request]:
+        """Decode-failure recovery: mark every in-flight request FAILED,
+        reclaim its pages, reset device slot state (re-init the cache if
+        the failed dispatch consumed the donated buffers), and leave the
+        engine serving. The queue is untouched — queued requests admit
+        into the freed slots on the next cycle."""
+        self._consecutive_failures += 1
+        self._faults_absorbed += 1
+        self._last_error = "%s: %s" % (type(exc).__name__, exc)
+        _sm.FAULTS.inc()
+        failed: List[Request] = []
+        for slot in range(self.cfg.slots):
+            req = self.scheduler.slot_request(slot)
+            if req is None:
+                continue
+            req.error = self._last_error
+            failed.append(self._retire(slot, state=FAILED,
+                                       clear_slot=False))
+        b = self.cfg.slots
+        self._len = jnp.zeros((b,), jnp.int32)
+        self._tok = jnp.zeros((b,), jnp.int32)
+        self._active = jnp.zeros((b,), jnp.bool_)
+        self._gen = jnp.zeros((b,), jnp.int32)
+        self._maxnew = jnp.ones((b,), jnp.int32)
+        if self._cache_lost():
+            self._cache = self.cache_ops.init_state()
+        return failed
 
     def _batch_spec(self) -> dict:
         """The in-flight batch, host view — what the flight recorder keeps

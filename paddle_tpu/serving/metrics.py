@@ -18,6 +18,7 @@ __all__ = [
     "PREFILL_COUNT", "DECODE_STEPS", "DECODE_DISPATCHES",
     "TOKENS_GENERATED", "TOKENS_PER_SEC",
     "REQUEST_LATENCY_MS", "TTFT_MS", "DECODE_STEP_MS", "PREFILL_MS",
+    "FAULTS", "RETRIES", "TIMEOUTS", "REQUESTS_FAILED",
 ]
 
 REQUESTS_SUBMITTED = _mx.counter(
@@ -63,3 +64,18 @@ DECODE_STEP_MS = _mx.histogram(
     help="host wall time of one decode dispatch / fused steps")
 PREFILL_MS = _mx.histogram(
     "serving/prefill_ms", help="host wall time of one compiled prefill call")
+FAULTS = _mx.counter(
+    "serving/faults",
+    help="decode dispatch failures absorbed by the recovery path (the "
+         "in-flight batch was failed, the engine kept serving)")
+RETRIES = _mx.counter(
+    "serving/retries",
+    help="decode dispatches retried after a transient-classified failure")
+TIMEOUTS = _mx.counter(
+    "serving/timeouts",
+    help="requests retired with TIMEOUT status at their deadline (queued "
+         "or running; slots and pages reclaimed)")
+REQUESTS_FAILED = _mx.counter(
+    "serving/requests_failed",
+    help="requests retired as FAILED when their in-flight batch was lost "
+         "to a decode failure")

@@ -69,6 +69,16 @@ class PagePool:
         n = int(n)
         if n < 0:
             raise ValueError("cannot allocate %d pages" % n)
+        from ..reliability import faults as _faults
+
+        spec = _faults.fire("page_pool.alloc")
+        if spec is not None and spec.kind == "exhausted":
+            # chaos drill: behave exactly like a real exhaustion — the
+            # caller's backpressure path must absorb it
+            raise PagePoolExhausted(
+                "page pool exhausted (injected): need %d pages of %d — "
+                "request stays queued until pages retire"
+                % (n, self.num_pages))
         if n > len(self._free):
             raise PagePoolExhausted(
                 "page pool exhausted: need %d pages, %d free of %d "

@@ -15,12 +15,15 @@ import time
 from typing import List, Optional, Sequence
 
 __all__ = ["Request", "BackpressureError",
-           "QUEUED", "RUNNING", "FINISHED", "REJECTED"]
+           "QUEUED", "RUNNING", "FINISHED", "REJECTED",
+           "TIMEOUT", "FAILED"]
 
 QUEUED = "queued"
 RUNNING = "running"
 FINISHED = "finished"
 REJECTED = "rejected"
+TIMEOUT = "timeout"    # deadline expired before completion (typed retirement)
+FAILED = "failed"      # in-flight batch lost to a decode failure
 
 _ids = itertools.count()
 
@@ -37,17 +40,24 @@ class Request:
 
     ``prompt`` is a sequence of int token ids; ``max_new_tokens`` bounds
     generation (the prefill's first sampled token counts toward it).
+    ``deadline_s`` (optional) is a wall-clock budget from submission: a
+    request past its deadline is retired with state :data:`TIMEOUT` so it
+    stops pinning a slot and KV pages. ``error`` carries the failure text
+    when a decode failure retires the request as :data:`FAILED`.
     """
 
     __slots__ = ("id", "prompt", "max_new_tokens", "state", "slot", "pages",
                  "tokens_out", "submitted_t", "admitted_t", "first_token_t",
-                 "finished_t")
+                 "finished_t", "deadline_s", "error")
 
-    def __init__(self, prompt: Sequence[int], max_new_tokens: int):
+    def __init__(self, prompt: Sequence[int], max_new_tokens: int,
+                 deadline_s: Optional[float] = None):
         if len(prompt) == 0:
             raise ValueError("Request needs a non-empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if deadline_s is not None and deadline_s < 0:
+            raise ValueError("deadline_s must be >= 0")
         self.id = next(_ids)
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -59,6 +69,8 @@ class Request:
         self.admitted_t: Optional[float] = None
         self.first_token_t: Optional[float] = None
         self.finished_t: Optional[float] = None
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        self.error: Optional[str] = None
 
     @property
     def prompt_len(self) -> int:
@@ -75,6 +87,15 @@ class Request:
         if self.first_token_t is None:
             return None
         return self.first_token_t - self.submitted_t
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        """True once the wall clock passed this request's deadline (always
+        False without one)."""
+        if self.deadline_s is None:
+            return False
+        if now is None:
+            now = time.perf_counter()
+        return now - self.submitted_t >= self.deadline_s
 
     def __repr__(self):
         return ("Request(id=%d, state=%s, prompt_len=%d, out=%d/%d, slot=%s)"

@@ -21,7 +21,8 @@ from collections import deque
 from typing import Deque, List, Optional
 
 from . import metrics as _sm
-from .request import (FINISHED, QUEUED, RUNNING, BackpressureError, Request)
+from .request import (FAILED, FINISHED, QUEUED, RUNNING, TIMEOUT,
+                      BackpressureError, Request)
 
 __all__ = ["Scheduler"]
 
@@ -111,13 +112,34 @@ class Scheduler:
         not starve an early big one ... they wait behind it."""
         _sm.ADMISSION_BLOCKED.inc()
 
-    def retire(self, slot: int) -> Request:
+    def retire(self, slot: int, state: str = FINISHED) -> Request:
+        """Vacate ``slot``; ``state`` is the request's terminal state —
+        FINISHED (default), TIMEOUT (deadline) or FAILED (batch lost to a
+        decode failure). Every path counts as a retirement (the slot was
+        reclaimed); the engine keeps the per-cause counters."""
         req = self._slots[slot]
         if req is None:
             raise ValueError("retire() on empty slot %d" % slot)
+        if state not in (FINISHED, TIMEOUT, FAILED):
+            raise ValueError("invalid terminal state %r" % state)
         self._slots[slot] = None
-        req.state = FINISHED
+        req.state = state
         req.slot = None
         _sm.REQUESTS_RETIRED.inc()
         _sm.SLOT_OCCUPANCY.set(self.occupancy)
         return req
+
+    def drop_expired(self, now: float) -> List[Request]:
+        """Remove queued requests whose deadline passed (they never got a
+        slot); returns them, terminal state set to TIMEOUT. Running
+        requests' deadlines are the engine's to enforce — it owns their
+        pages and device state."""
+        expired = [r for r in self._queue if r.expired(now)]
+        if expired:
+            keep = [r for r in self._queue if not r.expired(now)]
+            self._queue.clear()
+            self._queue.extend(keep)
+            for r in expired:
+                r.state = TIMEOUT
+            _sm.QUEUE_DEPTH.set(len(self._queue))
+        return expired

@@ -1,0 +1,73 @@
+"""Subprocess entry for the kill/resume drill in test_reliability.py.
+
+Runs ``run_supervised`` over a deterministic dropout model. Usage::
+
+    python reliability_runner.py <checkpoint_dir> <total_steps>
+
+Environment:
+  PADDLE_TPU_FAULT_PLAN  e.g. ``executor.dispatch@3=preempt`` — the fault
+                         framework SIGTERMs this process mid-run through
+                         the real OS signal path, making the drill's kill
+                         point deterministic (the parent still observes a
+                         genuine SIGTERM-triggered checkpoint-and-exit).
+
+Prints one ``SUP_STEP:<global_step>:<loss-bits-hex>`` line per executed
+step (bit-exact comparison fodder), ``SUP_RESUMED:<start>`` when a
+checkpoint was restored, and exits with ``EXIT_PREEMPTED`` (42) when the
+run was preempted, 0 on completion.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np
+
+
+def feed_source(start):
+    def gen():
+        s = start
+        while True:
+            r = np.random.RandomState(7000 + s)
+            yield {"x": r.randn(8, 8).astype("float32"),
+                   "y": r.randint(0, 4, (8, 1)).astype("int64")}
+            s += 1
+    return gen()
+
+
+def main():
+    ckpt_dir, total = sys.argv[1], int(sys.argv[2])
+
+    import paddle_tpu as fluid
+    from paddle_tpu.reliability import EXIT_PREEMPTED, run_supervised
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = startup.random_seed = 4242
+    with fluid.program_guard(main_prog, startup):
+        x = fluid.layers.data("x", shape=[8])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        # dropout: the drill must prove the per-step RNG stream resumes too
+        h = fluid.layers.dropout(h, dropout_prob=0.25)
+        logits = fluid.layers.fc(h, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    res = run_supervised(
+        exe, main_prog, feed_source, total, [loss],
+        checkpoint_dir=ckpt_dir, fetch_every=2, checkpoint_every_steps=2,
+        backoff_s=0.0, exit_on_preempt=False)
+    if res.resumed:
+        print("SUP_RESUMED:%d" % res.start_step, flush=True)
+    for i, row in enumerate(res.losses):
+        bits = np.float32(np.asarray(row[0]).ravel()[0]).tobytes().hex()
+        print("SUP_STEP:%d:%s" % (res.start_step + i, bits), flush=True)
+    sys.exit(EXIT_PREEMPTED if res.preempted else 0)
+
+
+if __name__ == "__main__":
+    main()

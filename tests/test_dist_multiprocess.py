@@ -88,3 +88,72 @@ def test_four_process_data_parallel():
     for s, d in zip(single, ls[0]):
         assert abs(s - d) < 1e-4, (single, ls[0])
     assert ls[0][-1] < ls[0][0], ls[0]
+
+
+# -- the multi-process crash drill (ISSUE 7) ----------------------------------
+
+def _crash_cluster(n, ckpt_dir, hb_dir, extra=None, timeout=120):
+    """Launch an n-rank crash-mode cluster; returns [(returncode, out)]."""
+    env = {"DIST_MODE": "crash", "DIST_STEPS": "6", "DIST_HB_TIMEOUT": "4",
+           "DIST_CKPT_DIR": ckpt_dir, "DIST_HB_DIR": hb_dir}
+    env.update(extra or {})
+    procs = [_launch(i, n, 23510, env, local_devices=1) for i in range(n)]
+    results = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            results.append((p.returncode, out))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    return results
+
+
+def _step_losses(out, pid):
+    """{global step: loss-bits-hex} from a rank's DIST_STEP lines."""
+    return {int(s): h for p, s, h in re.findall(
+        r"DIST_STEP:(\d+):(\d+):([0-9a-f]{8})", out) if int(p) == pid}
+
+
+def test_crash_drill_kill_one_trainer_then_resume(tmp_path):
+    """Kill rank 0 (the checkpointer) mid-run with SIGKILL: the survivor
+    must exit with a clean DIST_PEER_LOST diagnostic and the marked
+    EXIT_PEER_LOST code instead of hanging; a restart-all must resume from
+    the last published checkpoint and reproduce the uninterrupted loss
+    trajectory bit-for-bit."""
+    # uninterrupted reference: both ranks complete and agree per step
+    ref = _crash_cluster(2, str(tmp_path / "ref_ck"), str(tmp_path / "ref_hb"))
+    assert [rc for rc, _ in ref] == [0, 0], ref
+    ref_losses = _step_losses(ref[0][1], 0)
+    assert sorted(ref_losses) == list(range(6)), ref_losses
+    assert ref_losses == _step_losses(ref[1][1], 1), "replication parity"
+
+    # crashed run: rank 0 SIGKILLs itself before step 3
+    ck = str(tmp_path / "ck")
+    crashed = _crash_cluster(
+        2, ck, str(tmp_path / "hb1"),
+        extra={"DIST_KILL_RANK": "0", "DIST_KILL_AT_STEP": "3"})
+    rc0, out0 = crashed[0]
+    rc1, out1 = crashed[1]
+    assert rc0 == -9, (rc0, out0)  # hard kill, no cleanup
+    # the survivor exits with the marked code + diagnostic, not a hang
+    assert rc1 == 43, (rc1, out1)
+    assert "DIST_PEER_LOST:rank=1:lost=0" in out1, out1
+    surv = _step_losses(out1, 1)
+    assert all(surv[s] == ref_losses[s] for s in surv), (surv, ref_losses)
+    # rank 0 published checkpoints for steps 1..3 before dying
+    assert _step_losses(out0, 0) == {s: ref_losses[s] for s in range(3)}
+
+    # restart-all: resume from the last published serial (step 3), finish,
+    # and match the uninterrupted trajectory bit-for-bit
+    resumed = _crash_cluster(2, ck, str(tmp_path / "hb2"),
+                             extra={"DIST_RESUME": "1"})
+    assert [rc for rc, _ in resumed] == [0, 0], resumed
+    for pid, (_, out) in enumerate(resumed):
+        assert ("DIST_RESUMED:%d:3" % pid) in out, out
+        got = _step_losses(out, pid)
+        assert sorted(got) == [3, 4, 5], got
+        assert got == {s: ref_losses[s] for s in (3, 4, 5)}, \
+            "resumed trajectory diverged from the uninterrupted run"

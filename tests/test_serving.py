@@ -212,16 +212,21 @@ def test_pool_exhaustion_queues_not_crashes(rng, monkeypatch, tmp_path):
 
 def test_flight_recorder_captures_batch_on_decode_failure(
         rng, monkeypatch, tmp_path):
+    """A decode failure is flight-dumped AND absorbed (ISSUE 7): the batch
+    is FAILED with pages reclaimed, and the engine survives — fail_fast
+    restores the old raise-through behavior for debugging."""
     monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
     eng = serving.ServingEngine(get_model(), small_config())
-    eng.submit(list(rng.randint(0, 64, 8)), 4)
+    req = eng.submit(list(rng.randint(0, 64, 8)), 4)
 
     def boom(*a, **kw):
         raise RuntimeError("injected decode failure")
 
     eng._decode_exe[eng.cfg.decode_fuse] = boom
-    with pytest.raises(RuntimeError, match="injected decode failure"):
-        eng.step()
+    done = eng.step()  # absorbed, not raised
+    assert [r.id for r in done] == [req.id] and req.state == "failed"
+    assert req.error and not req.pages and eng.pool.num_used == 0
+    assert eng.health()["status"] == "degraded"
     dumps = [f for f in os.listdir(str(tmp_path)) if f.startswith("flight_")]
     assert dumps, "no flight dump written"
     with open(os.path.join(str(tmp_path), sorted(dumps)[-1])) as f:
@@ -233,6 +238,13 @@ def test_flight_recorder_captures_batch_on_decode_failure(
     spec = batches[-1]
     assert spec["slots"] and spec["slots"][0]["prompt_len"] == 8
     assert spec["layout"] == "paged"
+
+    # fail_fast: the old contract, raise through after the dump
+    eng2 = serving.ServingEngine(get_model(), small_config(fail_fast=True))
+    eng2.submit(list(rng.randint(0, 64, 8)), 4)
+    eng2._decode_exe[eng2.cfg.decode_fuse] = boom
+    with pytest.raises(RuntimeError, match="injected decode failure"):
+        eng2.step()
 
 
 def test_submit_validation_and_immediate_finish(rng):

@@ -226,6 +226,30 @@ def selftest() -> int:
     assert snap["serving/page_pool_utilization"]["value"] == 0
     assert r2.state == "queued"  # blocked head stays FIFO-first
     metrics.reset()
+
+    # 6. reliability instruments + the fault framework's registry feed:
+    #    an armed plan firing must tick reliability/faults_injected (the
+    #    full recovery drills have their own gate, tools/chaos_drill
+    #    --selftest)
+    from paddle_tpu.reliability import (FaultPlan, TransientFault, faults,
+                                        run_supervised)  # noqa: F401
+    # (run_supervised imported for its side effect: loading the supervisor
+    # registers the reliability/preemptions|checkpoints|... instruments)
+
+    with FaultPlan.parse("executor.compile@1=transient"):
+        try:
+            faults.fire("executor.compile")
+            raise AssertionError("armed fault did not fire")
+        except TransientFault:
+            pass
+    snap = metrics.snapshot()
+    assert snap["reliability/faults_injected"]["value"] == 1
+    for name in ("reliability/preemptions", "reliability/retries",
+                 "reliability/checkpoints_written", "reliability/resumes",
+                 "serving/faults", "serving/retries", "serving/timeouts",
+                 "serving/requests_failed"):
+        assert name in snap, "missing instrument %s" % name
+    metrics.reset()
     print("dump_metrics selftest: OK")
     return 0
 

@@ -45,6 +45,9 @@ MODULES = [
     "paddle_tpu.contrib",
     "paddle_tpu.inference",
     "paddle_tpu.serving",
+    "paddle_tpu.reliability",
+    "paddle_tpu.reliability.faults",
+    "paddle_tpu.reliability.supervisor",
     "paddle_tpu.dataset",
 ]
 

@@ -162,14 +162,28 @@ def selftest() -> int:
         assert len(r.tokens_out) == r.max_new_tokens, r
         assert r.latency_s is not None and r.ttft_s is not None
     assert eng.scheduler.idle() and eng.pool.num_used == 0
+    # page-leak invariant: every retirement path must have returned its
+    # pages — the pool's used count equals the pages held by running
+    # requests (zero here), and the engine agrees it is healthy
+    assert eng.page_accounting_ok(), "page accounting leak after drain"
+    health = eng.health()
+    assert health["status"] == "ok" and health["page_accounting_ok"], health
+    # a deadline of 0 must be retired TIMEOUT without pinning slot or pages
+    late = eng.submit([1, 2, 3], 4, deadline_s=0.0)
+    eng.run(max_steps=50)
+    assert late.state == "timeout" and not late.pages, late
+    assert eng.pool.num_used == 0 and eng.page_accounting_ok()
     # the serving/* instruments must exist and be consistent
     snap = mx.snapshot()
     for name in ("serving/requests_submitted", "serving/requests_admitted",
                  "serving/requests_retired", "serving/tokens_generated",
                  "serving/decode_steps", "serving/prefills",
                  "serving/request_latency_ms", "serving/ttft_ms",
-                 "serving/page_pool_pages_in_use"):
+                 "serving/page_pool_pages_in_use",
+                 "serving/faults", "serving/retries", "serving/timeouts",
+                 "serving/requests_failed"):
         assert name in snap, "missing instrument %s" % name
+    assert snap["serving/timeouts"]["value"] >= 1
     assert snap["serving/requests_retired"]["value"] >= 6
     assert snap["serving/requests_admitted"]["value"] >= 6
     assert snap["serving/tokens_generated"]["value"] >= sum(
