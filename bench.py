@@ -1249,13 +1249,19 @@ def main():
             "detail": res,
             "metrics": _monitor_metrics_section(),
         }))
-        print(json.dumps({"summary": {"serve": {
+        serve_summary = {
             "qps": cont["qps"],
             "latency_p50_ms": cont["latency_p50_ms"],
             "latency_p99_ms": cont["latency_p99_ms"],
             "tokens_per_sec": cont["tokens_per_sec"],
             "qps_ratio_vs_padded": res["qps_ratio_vs_padded"],
-        }}}))
+        }
+        # observability artifacts (armed via PADDLE_TPU_TRACE_FILE /
+        # PADDLE_TPU_TELEMETRY_DIR) surface in the truncation-proof tail
+        for key in ("trace_file", "telemetry_dir"):
+            if key in res:
+                serve_summary[key] = res[key]
+        print(json.dumps({"summary": {"serve": serve_summary}}))
         return 0
 
     if len(sys.argv) > 1 and sys.argv[1] == "--mesh":

@@ -23,6 +23,18 @@ TPU-native in three pieces:
   (``PADDLE_TPU_CHECK_NUMERICS``), explicit-collective byte accounting
   (``collectives/*``), and the crash flight recorder
   (``PADDLE_TPU_FLIGHT_DIR``).
+* :mod:`~paddle_tpu.monitor.telemetry` — CONTINUOUS export: a background
+  thread snapshots the registry on an interval into a bounded JSONL
+  time-series ring (``PADDLE_TPU_TELEMETRY_DIR``), renders Prometheus
+  text (``monitor.to_prometheus()``), and drives the per-tick SLO
+  evaluation of the next module.
+* :mod:`~paddle_tpu.monitor.slo` — declarative SLOs
+  (``SLO("serving/request_latency_ms", p=99, max_ms=250)``) evaluated per
+  export tick against interval deltas; breaches count, hit the flight
+  recorder, and (opt-in) degrade ``ServingEngine.health()``.
+* :mod:`~paddle_tpu.monitor.budgets` — checked-in closed-form
+  collective-traffic budgets asserted against the measured
+  ``collectives/*`` counters (``tools/check_budgets.py``).
 
 Quick tour::
 
@@ -39,17 +51,20 @@ from __future__ import annotations
 
 import os
 
-from . import device, metrics, tracer  # noqa: F401
+from . import budgets, device, metrics, slo, telemetry, tracer  # noqa: F401
 from .metrics import (  # noqa: F401
     counter, gauge, histogram, enabled, enable, disable,
-    snapshot, to_json, to_text, reset,
+    snapshot, to_json, to_text, to_prometheus, reset,
 )
+from .slo import SLO, SLOMonitor  # noqa: F401
 from .step_logger import StepLogger  # noqa: F401
+from .telemetry import TelemetryExporter  # noqa: F401
 
 __all__ = [
-    "device", "metrics", "tracer", "StepLogger",
+    "budgets", "device", "metrics", "slo", "telemetry", "tracer",
+    "StepLogger", "SLO", "SLOMonitor", "TelemetryExporter",
     "counter", "gauge", "histogram", "enabled", "enable", "disable",
-    "snapshot", "to_json", "to_text", "reset",
+    "snapshot", "to_json", "to_text", "to_prometheus", "reset",
     "GRAD_NORM_VAR", "grad_norm_enabled",
 ]
 

@@ -1,0 +1,145 @@
+"""Checked-in collective-traffic budgets vs the measured ``collectives/*``.
+
+PR 5 made explicit collective volume *measured* (``record_collective`` at
+the gpipe/ring-attention ppermute and CTR all_to_all emission sites);
+this module makes it *enforced*: each leg has a closed-form bytes-per-step
+budget derived from first principles, and :func:`check_budget` asserts a
+measured counter against it. A refactor that silently doubles ICI traffic
+(an extra rotation, a dtype widening, a lost donation) now fails
+``tools/check_budgets.py --selftest`` and ``dryrun_multichip`` instead of
+shipping.
+
+The closed forms (per device, per traced step, matching exactly what the
+emission sites record at trace time):
+
+* **gpipe forward** (``parallel/pipeline.py``): with M microbatches over S
+  stages, activation bytes A per microbatch — feed hops ship every
+  microbatch not already on stage 0 (M - M/S), collect hops return every
+  output not finishing on the last stage (M - M/S), and the tick rotation
+  runs M+S-2 times: ``(2*(M - M/S) + M + S - 2) * A``. (The backward
+  schedule is JAX AD transposing these permutes — same volume again, not
+  separately recorded.)
+* **ring attention** (``parallel/ring_attention.py``): K and V blocks of B
+  bytes each rotate N hops per step: forward ``2*N*B``; backward re-rotates
+  K/V and travels the f32 dK/dV accumulators: ``2*N*B + 2*N*B_f32``.
+* **CTR row routing** (``core/sparse.route_rows_to_shards``): each shard
+  exchanges fixed-capacity buckets — ids ``[n_shards, n_local]`` plus rows
+  ``[n_shards, n_local, D]``: ``n_shards * n_local * (id_itemsize +
+  D * row_itemsize)`` per routing call (ids AND rows legs summed).
+
+Budgets are exact when the leg is traced once; pass ``slack`` only for
+sites a caller traces a variable number of times.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+__all__ = [
+    "COLLECTIVE_BUDGETS", "CollectiveBudgetExceeded",
+    "gpipe_fwd_bytes", "ring_attention_fwd_bytes",
+    "ring_attention_bwd_bytes", "ctr_row_routing_bytes",
+    "budget_bytes", "check_budget",
+]
+
+
+class CollectiveBudgetExceeded(AssertionError):
+    """Measured collective bytes exceed the closed-form budget — a real
+    traffic regression (or a budget that must be consciously re-derived
+    and updated in the same commit)."""
+
+
+def gpipe_fwd_bytes(microbatches: int, stages: int,
+                    activation_bytes: int) -> int:
+    """Forward-trace ppermute bytes of one gpipe step (module docstring).
+    ``microbatches`` is the padded count (a multiple of ``stages``)."""
+    m, s = int(microbatches), int(stages)
+    if m % s:
+        m = -(-m // s) * s  # the ragged-M pad the builder applies
+    return (2 * (m - m // s) + m + s - 2) * int(activation_bytes)
+
+
+def ring_attention_fwd_bytes(n_devices: int, block_bytes: int) -> int:
+    """K + V local blocks, each rotated ``n_devices`` hops."""
+    return 2 * int(n_devices) * int(block_bytes)
+
+
+def ring_attention_bwd_bytes(n_devices: int, block_bytes: int,
+                             block_elems: int) -> int:
+    """Backward ring: K/V in input dtype plus f32 dK/dV accumulators."""
+    return (2 * int(n_devices) * int(block_bytes)
+            + 2 * int(n_devices) * int(block_elems) * 4)
+
+
+def ctr_row_routing_bytes(n_shards: int, n_local: int, dim: int,
+                          id_itemsize: int = 4,
+                          row_itemsize: int = 4) -> int:
+    """One ``route_rows_to_shards`` call: the id bucket exchange plus the
+    row bucket exchange (both fixed worst-case capacity)."""
+    return int(n_shards) * int(n_local) * (
+        int(id_itemsize) + int(dim) * int(row_itemsize))
+
+
+COLLECTIVE_BUDGETS: Dict[str, Dict[str, Any]] = {
+    "gpipe.fwd": {
+        "counter": "collectives/ppermute/bytes",
+        "formula": gpipe_fwd_bytes,
+        "params": ("microbatches", "stages", "activation_bytes"),
+        "doc": "GPipe feed/collect/rotate hops of one forward trace",
+    },
+    "ring_attention.fwd": {
+        "counter": "collectives/ppermute/bytes",
+        "formula": ring_attention_fwd_bytes,
+        "params": ("n_devices", "block_bytes"),
+        "doc": "ring-attention forward K/V rotation",
+    },
+    "ring_attention.bwd": {
+        "counter": "collectives/ppermute/bytes",
+        "formula": ring_attention_bwd_bytes,
+        "params": ("n_devices", "block_bytes", "block_elems"),
+        "doc": "ring-attention backward K/V + f32 dK/dV accumulators",
+    },
+    "ctr.row_routing": {
+        "counter": "collectives/all_to_all/bytes",
+        "formula": ctr_row_routing_bytes,
+        "params": ("n_shards", "n_local", "dim", "id_itemsize",
+                   "row_itemsize"),
+        "doc": "PS-style sparse-row all_to_all exchange (ids + rows)",
+    },
+}
+
+
+def budget_bytes(leg: str, **params) -> int:
+    """Evaluate the checked-in closed form for ``leg`` at ``params``."""
+    spec = COLLECTIVE_BUDGETS.get(leg)
+    if spec is None:
+        raise KeyError("unknown collective budget leg %r (have: %s)"
+                       % (leg, ", ".join(sorted(COLLECTIVE_BUDGETS))))
+    fn: Callable = spec["formula"]
+    return int(fn(**params))
+
+
+def check_budget(leg: str, measured_bytes: float, budget: int = None,
+                 slack: float = 0.0, **params) -> dict:
+    """Assert ``measured_bytes <= budget * (1 + slack)``.
+
+    ``budget=None`` evaluates the leg's closed form at ``params`` (the
+    normal path); an explicit ``budget`` overrides it (how the selftest
+    proves a tightened budget fails loudly). Returns the comparison
+    record on success; raises :class:`CollectiveBudgetExceeded` naming
+    leg, measured, budget and the parameterization on failure."""
+    if budget is None:
+        budget = budget_bytes(leg, **params)
+    limit = budget * (1.0 + max(0.0, slack))
+    rec = {"leg": leg, "counter": COLLECTIVE_BUDGETS[leg]["counter"],
+           "measured_bytes": int(measured_bytes), "budget_bytes": int(budget),
+           "slack": slack, "params": params,
+           "utilization": (measured_bytes / budget) if budget else None}
+    if measured_bytes > limit:
+        raise CollectiveBudgetExceeded(
+            "collective budget exceeded for %s: measured %d B > budget %d B"
+            "%s (params=%r) — a real traffic regression, or re-derive the "
+            "closed form in monitor/budgets.py in the same commit"
+            % (leg, measured_bytes, budget,
+               (" (+%g%% slack)" % (100 * slack)) if slack else "", params))
+    return rec

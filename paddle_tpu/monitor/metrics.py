@@ -17,7 +17,10 @@ serving stacks do it (Prometheus-style instruments). Three design rules:
    and tests can look instruments up by name.
 
 Export surfaces: ``snapshot()`` (plain dict), ``to_json()``, ``to_text()``
-(one line per instrument), ``reset()`` (zero values, keep registrations).
+(one line per instrument), ``to_prometheus()`` (text exposition format a
+promtool-style validator parses: sanitized names, cumulative ``_bucket``
+counts + ``_sum``/``_count`` per histogram), ``reset()`` (zero values, keep
+registrations).
 """
 
 from __future__ import annotations
@@ -25,6 +28,7 @@ from __future__ import annotations
 import json
 import math
 import os
+import re
 import threading
 from typing import Dict, List, Optional, Sequence
 
@@ -32,7 +36,8 @@ __all__ = [
     "Counter", "Gauge", "Histogram",
     "counter", "gauge", "histogram",
     "enabled", "enable", "disable",
-    "snapshot", "to_json", "to_text", "reset",
+    "snapshot", "to_json", "to_text", "to_prometheus", "prometheus_name",
+    "reset",
     "DEFAULT_TIME_BUCKETS_MS", "sorted_percentile",
 ]
 
@@ -326,6 +331,72 @@ def to_text() -> str:
         else:
             lines.append("%-40s %-5s value=%g" % (name, t, snap["value"]))
     return "\n".join(lines)
+
+
+def prometheus_name(name: str) -> str:
+    """Instrument name → a valid Prometheus metric name: ``/`` and ``:``
+    (and any other illegal character) become ``_``; a leading digit gains a
+    ``_`` prefix. Distinct registry names stay distinct in practice because
+    every instrument here uses ``/``-separated word segments."""
+    out = re.sub(r"[^a-zA-Z0-9_]", "_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _prom_escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _prom_num(v: float) -> str:
+    if v != v:  # NaN
+        return "NaN"
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return "%d" % int(v)
+    return repr(float(v))
+
+
+def to_prometheus() -> str:
+    """Render the registry in the Prometheus text exposition format (0.0.4).
+
+    Counters/gauges are one sample each; histograms emit the standard
+    triplet — CUMULATIVE ``<name>_bucket{le="..."}`` counts ending in
+    ``le="+Inf"``, plus ``<name>_sum`` and ``<name>_count`` — so the output
+    parses under promtool-style validators and ``histogram_quantile``
+    works on a scrape of it. Names are sanitized via
+    :func:`prometheus_name` (``serving/ttft_ms`` → ``serving_ttft_ms``).
+    """
+    with _registry_lock:
+        items = sorted(_registry.items())
+    lines: List[str] = []
+    for name, inst in items:
+        pname = prometheus_name(name)
+        if inst.help:
+            lines.append("# HELP %s %s" % (pname, _prom_escape_help(inst.help)))
+        if isinstance(inst, Histogram):
+            lines.append("# TYPE %s histogram" % pname)
+            snap = inst.snapshot()
+            cum = 0
+            bounds = list(inst.bounds)
+            counts = [snap["buckets"]["le_%g" % b] for b in bounds]
+            counts.append(snap["buckets"]["le_inf"])
+            for b, c in zip(bounds + [math.inf], counts):
+                cum += c
+                le = "+Inf" if b == math.inf else _prom_num(b)
+                lines.append('%s_bucket{le="%s"} %d' % (pname, le, cum))
+            lines.append("%s_sum %s" % (pname, _prom_num(snap["sum"])))
+            lines.append("%s_count %d" % (pname, snap["count"]))
+        elif isinstance(inst, Counter):
+            lines.append("# TYPE %s counter" % pname)
+            lines.append("%s %s" % (pname, _prom_num(inst.value)))
+        else:
+            lines.append("# TYPE %s gauge" % pname)
+            lines.append("%s %s" % (pname, _prom_num(inst.value)))
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 def reset() -> None:

@@ -1,0 +1,290 @@
+"""Declarative SLOs evaluated per telemetry tick against interval deltas.
+
+A crash is loud; *slow death* — p99 latency creeping past budget, error
+rate climbing, throughput sagging, the queue backing up — is silent until
+someone reads a dashboard. This module turns those conditions into typed
+specs the telemetry exporter evaluates every export tick:
+
+    SLO("serving/request_latency_ms", p=99, max_ms=250)     # latency ceiling
+    SLO("serving/queue_depth", max_value=512)               # gauge ceiling
+    SLO("serving/requests_retired", min_rate=10)            # QPS floor
+    SLO("serving/requests_failed", max_ratio=0.01,          # error-rate cap
+        over="serving/requests_retired")
+
+Every evaluation runs on ONE interval's deltas (the
+:class:`~paddle_tpu.monitor.telemetry.TelemetrySample`), not lifetime
+aggregates — a latency regression shows up within one tick even after a
+million healthy requests. A breach:
+
+* increments ``slo/breaches`` (and ``slo/<spec>/breaches``),
+* records an ``slo_breach`` flight-recorder event carrying the offending
+  window (tick seq/t/dt, observed value, threshold),
+* invokes the monitor's ``on_breach`` callback — the serving engine wires
+  this (opt-in per spec via ``degrade=True``, the default) to flip
+  ``engine.health()`` to ``degraded``, so the PR 7 recovery ladder and
+  external health checks see slow-death, not just exceptions.
+
+A tick with zero breaches invokes ``on_clear`` so a degraded engine
+recovers once the signal is healthy again.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+from . import metrics as _mx
+
+__all__ = ["SLO", "Breach", "SLOMonitor", "parse_slos"]
+
+_c_breaches = _mx.counter(
+    "slo/breaches", help="SLO breaches across all specs and ticks")
+_c_evals = _mx.counter(
+    "slo/evaluations", help="per-tick SLO spec evaluations performed")
+
+
+class Breach:
+    """One spec violated on one telemetry tick."""
+
+    __slots__ = ("slo", "value", "threshold", "window")
+
+    def __init__(self, slo: "SLO", value: float, threshold: float,
+                 window: dict):
+        self.slo = slo
+        self.value = value
+        self.threshold = threshold
+        self.window = window
+
+    def to_doc(self) -> dict:
+        # key is "slo_kind", not "kind": the doc doubles as the
+        # flight-recorder event payload, whose own positional is "kind"
+        return {"slo": self.slo.name, "slo_kind": self.slo.kind,
+                "metric": self.slo.metric, "value": self.value,
+                "threshold": self.threshold, "window": self.window}
+
+    def __repr__(self):
+        return ("Breach(%s: %s=%.4g vs %.4g over %.3gs)"
+                % (self.slo.name, self.slo.kind, self.value,
+                   self.threshold, self.window.get("dt_s", 0.0)))
+
+
+class SLO:
+    """One declarative objective over one instrument. Exactly one mode:
+
+    * ``p`` + ``max_ms`` — interval percentile of a histogram must stay
+      <= ``max_ms`` (any histogram unit works; the name says ms because
+      every latency histogram here is ms),
+    * ``max_value`` — gauge ceiling (queue depth, pool utilization),
+    * ``min_rate`` — counter-rate floor per second (QPS/throughput); only
+      evaluated on ticks where the counter moved at all unless
+      ``min_rate_strict=True`` (an idle engine is not a breach),
+    * ``max_ratio`` + ``over`` — interval error-rate cap:
+      delta(metric)/delta(over) <= max_ratio (skipped while delta(over)
+      is 0).
+
+    ``degrade=False`` keeps a breach observational (counted + recorded,
+    but the engine's health callback is not invoked for it).
+    """
+
+    __slots__ = ("metric", "kind", "p", "threshold", "over", "degrade",
+                 "min_rate_strict", "name", "_warned_type")
+
+    def __init__(self, metric: str, p: Optional[float] = None,
+                 max_ms: Optional[float] = None,
+                 max_value: Optional[float] = None,
+                 min_rate: Optional[float] = None,
+                 max_ratio: Optional[float] = None,
+                 over: Optional[str] = None,
+                 degrade: bool = True,
+                 min_rate_strict: bool = False,
+                 name: Optional[str] = None):
+        modes = [m for m, on in (
+            ("percentile", max_ms is not None),
+            ("ceiling", max_value is not None),
+            ("rate_floor", min_rate is not None),
+            ("error_rate", max_ratio is not None)) if on]
+        if len(modes) != 1:
+            raise ValueError(
+                "SLO(%r) needs exactly one of max_ms/max_value/min_rate/"
+                "max_ratio (got %s)" % (metric, modes or "none"))
+        self.kind = modes[0]
+        if self.kind == "percentile":
+            if p is None:
+                raise ValueError("SLO(%r, max_ms=...) needs p=<percentile>"
+                                 % metric)
+            self.threshold = float(max_ms)
+        elif self.kind == "ceiling":
+            self.threshold = float(max_value)
+        elif self.kind == "rate_floor":
+            self.threshold = float(min_rate)
+        else:
+            if not over:
+                raise ValueError("SLO(%r, max_ratio=...) needs over=<counter>"
+                                 % metric)
+            self.threshold = float(max_ratio)
+        self.metric = metric
+        self.p = None if p is None else float(p)
+        self.over = over
+        self.degrade = bool(degrade)
+        self.min_rate_strict = bool(min_rate_strict)
+        self._warned_type = False
+        if name:
+            self.name = name
+        elif self.kind == "percentile":
+            self.name = "%s:p%g" % (metric, self.p)
+        else:
+            self.name = "%s:%s" % (metric, self.kind)
+
+    def evaluate(self, sample) -> Optional[Breach]:
+        """Check this spec against one TelemetrySample; None = healthy or
+        not evaluable this tick (no observations in the window)."""
+        window = {"seq": sample.seq, "t": sample.t, "dt_s": sample.dt_s}
+        if self.kind == "percentile":
+            v = sample.histogram_interval_percentile(self.metric, self.p)
+            if v is None:
+                return None
+            d = sample.histogram_delta(self.metric) or {}
+            window["observations"] = d.get("count", 0)
+            return Breach(self, v, self.threshold, window) \
+                if v > self.threshold else None
+        if self.kind == "ceiling":
+            v = sample.gauge_value(self.metric)
+            if v is None:
+                snap = sample.metrics.get(self.metric)
+                if snap is not None and snap.get("type") != "gauge" \
+                        and not self._warned_type:
+                    # a ceiling on a counter would compare against the
+                    # LIFETIME total — refuse, loudly, once
+                    self._warned_type = True
+                    import logging
+
+                    logging.getLogger("paddle_tpu").warning(
+                        "SLO %s: max_value (gauge ceiling) on a %s "
+                        "instrument — spec is inert; use min_rate/"
+                        "max_ratio for counters", self.name,
+                        snap.get("type"))
+                return None
+            return Breach(self, v, self.threshold, window) \
+                if v > self.threshold else None
+        if self.kind == "rate_floor":
+            if sample.dt_s <= 0:
+                return None
+            delta = sample.counter_delta(self.metric)
+            if delta == 0 and not self.min_rate_strict:
+                return None  # idle, not slow
+            v = delta / sample.dt_s
+            return Breach(self, v, self.threshold, window) \
+                if v < self.threshold else None
+        # error_rate
+        den = sample.counter_delta(self.over)
+        if den <= 0:
+            return None
+        v = sample.counter_delta(self.metric) / den
+        window["errors"] = sample.counter_delta(self.metric)
+        window["total"] = den
+        return Breach(self, v, self.threshold, window) \
+            if v > self.threshold else None
+
+    def __repr__(self):
+        return "SLO(%s, %s<=%g)" % (self.name, self.kind, self.threshold) \
+            if self.kind != "rate_floor" \
+            else "SLO(%s, rate>=%g/s)" % (self.name, self.threshold)
+
+
+def parse_slos(text: str) -> List[SLO]:
+    """``PADDLE_TPU_SLO`` grammar: ``;``-separated entries,
+    ``metric:p99<=250`` (percentile ms) | ``metric<=512`` (gauge ceiling)
+    | ``metric>=10/s`` (rate floor) | ``metric/over<=0.01`` (error rate —
+    metric and denominator joined by ``over=``:
+    ``metric<=0.01 over other``)."""
+    out: List[SLO] = []
+    for raw in text.split(";"):
+        entry = raw.strip()
+        if not entry:
+            continue
+        over = None
+        if " over " in entry:
+            entry, over = entry.split(" over ", 1)
+            over = over.strip()
+        if ">=" in entry:
+            if over:
+                raise ValueError(
+                    "bad SLO entry %r: 'over' only combines with an "
+                    "error-rate cap (metric<=ratio over denominator), "
+                    "not a >= rate floor" % raw)
+            metric, rhs = entry.split(">=", 1)
+            rhs = rhs.strip()
+            if rhs.endswith("/s"):
+                rhs = rhs[:-2]
+            out.append(SLO(metric.strip(), min_rate=float(rhs)))
+            continue
+        if "<=" not in entry:
+            raise ValueError("bad SLO entry %r (need <= or >=)" % raw)
+        lhs, rhs = entry.split("<=", 1)
+        lhs = lhs.strip()
+        val = float(rhs)
+        if ":p" in lhs:
+            metric, p = lhs.rsplit(":p", 1)
+            out.append(SLO(metric, p=float(p), max_ms=val))
+        elif over:
+            out.append(SLO(lhs, max_ratio=val, over=over))
+        else:
+            out.append(SLO(lhs, max_value=val))
+    return out
+
+
+class SLOMonitor:
+    """Evaluates a spec list on every telemetry tick (register
+    :meth:`on_sample` as an exporter listener, or call it directly with a
+    sample for synchronous drills)."""
+
+    def __init__(self, specs: Sequence[SLO],
+                 on_breach: Optional[Callable[[Breach], None]] = None,
+                 on_clear: Optional[Callable[[], None]] = None):
+        self.specs = list(specs)
+        self.on_breach = on_breach
+        self.on_clear = on_clear
+        self._lock = threading.Lock()
+        self.breaches_total = 0
+        self.last_breaches: List[Breach] = []
+        self._spec_counters: Dict[str, _mx.Counter] = {
+            s.name: _mx.counter("slo/%s/breaches" % s.name)
+            for s in self.specs}
+
+    def on_sample(self, sample) -> List[Breach]:
+        breaches: List[Breach] = []
+        for spec in self.specs:
+            _c_evals.inc()
+            b = spec.evaluate(sample)
+            if b is not None:
+                breaches.append(b)
+        with self._lock:
+            self.last_breaches = breaches
+            self.breaches_total += len(breaches)
+        if breaches:
+            _c_breaches.inc(len(breaches))
+            from . import device as _dev
+
+            fr = _dev.flight_recorder()
+            for b in breaches:
+                self._spec_counters[b.slo.name].inc()
+                if fr is not None:
+                    fr.record_event("slo_breach", **b.to_doc())
+                if self.on_breach is not None and b.slo.degrade:
+                    try:
+                        self.on_breach(b)
+                    except Exception:
+                        import logging
+
+                        logging.getLogger("paddle_tpu").exception(
+                            "SLO on_breach callback failed (ignored)")
+        # recovery keys on the DEGRADE-relevant specs only: a breaching
+        # observational (degrade=False) spec is counted and recorded above
+        # but must not pin a healthy engine in "degraded" forever
+        if not any(b.slo.degrade for b in breaches) \
+                and self.on_clear is not None:
+            try:
+                self.on_clear()
+            except Exception:
+                pass
+        return breaches

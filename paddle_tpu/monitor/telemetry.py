@@ -1,0 +1,490 @@
+"""Continuous metrics export: registry snapshots → bounded JSONL ring.
+
+PR 1/5 made telemetry *readable* (``monitor.snapshot()``); a long-running
+serving engine or supervised training job needs it *streamed*: a time
+series an operator can tail, scrape, and alert on while the process is
+still alive. :class:`TelemetryExporter` is that streamer — a background
+thread that every ``interval_s``:
+
+1. snapshots the registry and computes INTERVAL DELTAS vs the previous
+   tick (counter increments, histogram bucket/count/sum deltas — the
+   inputs rate and percentile alerting need),
+2. appends one JSON line to a bounded on-disk ring
+   (``PADDLE_TPU_TELEMETRY_DIR``; ``telemetry_<pid>_<k>.jsonl`` files
+   rotated every ``PADDLE_TPU_TELEMETRY_ROTATE`` samples, oldest deleted
+   past ``PADDLE_TPU_TELEMETRY_KEEP`` files; each append is flushed+fsynced
+   so a crash loses at most the in-flight line),
+3. hands the sample to registered listeners — the
+   :mod:`~paddle_tpu.monitor.slo` monitor evaluates its specs here.
+
+Lifecycle: the exporter is a REFCOUNTED process singleton.
+``ServingEngine`` and ``run_supervised`` call :func:`acquire` on entry and
+:func:`release` on exit; the first acquire starts the thread, the last
+release stops it and flushes the final PARTIAL interval (so short drills
+still produce a series). With ``PADDLE_TPU_TELEMETRY_DIR`` unset the whole
+subsystem costs one env read — :func:`acquire` returns ``None``.
+
+Failure policy mirrors the flight recorder: an unwritable telemetry dir
+logs ONE error and disables the on-disk export — it never masks the run,
+and in-memory listeners (SLO evaluation) keep working.
+
+Prometheus: the same registry renders scrapeable text via
+``monitor.to_prometheus()``; :meth:`TelemetryExporter.write_prometheus`
+drops ``metrics.prom`` next to the ring on every tick for a file-based
+scrape (node-exporter textfile-collector style).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import re
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from . import metrics as _mx
+
+__all__ = [
+    "TelemetryExporter", "TelemetrySample", "acquire", "release",
+    "active_exporter", "force_tick", "read_series", "SAMPLE_SCHEMA",
+]
+
+SAMPLE_SCHEMA = "paddle_tpu.telemetry/v1"
+
+_log = logging.getLogger("paddle_tpu")
+
+_c_samples = _mx.counter(
+    "telemetry/samples", help="telemetry ring samples written (or handed to "
+                              "listeners when the dir is unwritable)")
+_c_rotations = _mx.counter(
+    "telemetry/rotations", help="telemetry ring file rotations")
+_c_write_errors = _mx.counter(
+    "telemetry/write_errors", help="telemetry ring write failures (first "
+                                   "one disables the on-disk export)")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class TelemetrySample:
+    """One export tick: the full snapshot plus interval deltas.
+
+    ``deltas["counters"]`` maps counter name → increment since the
+    previous tick (non-zero entries only); ``deltas["histograms"]`` maps
+    histogram name → ``{"count", "sum", "buckets": {le_*: delta}}`` for
+    histograms that saw observations this interval; ``deltas["gauges"]``
+    maps gauge name → current value for gauges that CHANGED this interval
+    (the snapshot value is the time-series point — the delta entry just
+    flags movement for change-driven consumers like ``--watch``).
+    """
+
+    __slots__ = ("seq", "t", "dt_s", "metrics", "deltas")
+
+    def __init__(self, seq: int, t: float, dt_s: float,
+                 metrics: Dict[str, dict], deltas: Dict[str, dict]):
+        self.seq = seq
+        self.t = t
+        self.dt_s = dt_s
+        self.metrics = metrics
+        self.deltas = deltas
+
+    def to_doc(self) -> dict:
+        # pid rides along so a consumer of a multi-process ring dir can
+        # keep one monotone seq cursor per writer
+        return {"schema": SAMPLE_SCHEMA, "seq": self.seq, "t": self.t,
+                "dt_s": self.dt_s, "pid": os.getpid(),
+                "deltas": self.deltas, "metrics": self.metrics}
+
+    def counter_delta(self, name: str) -> float:
+        return self.deltas.get("counters", {}).get(name, 0.0)
+
+    def counter_rate(self, name: str) -> float:
+        """Interval rate (delta / dt) — the QPS-style readout."""
+        if self.dt_s <= 0:
+            return 0.0
+        return self.counter_delta(name) / self.dt_s
+
+    def gauge_value(self, name: str) -> Optional[float]:
+        """Current value of a GAUGE instrument — None for anything else
+        (handing back a counter's lifetime total here would let a
+        mis-typed ceiling SLO compare against cumulative history)."""
+        snap = self.metrics.get(name)
+        if snap is None or snap.get("type") != "gauge":
+            return None
+        return float(snap.get("value", 0.0))
+
+    def histogram_delta(self, name: str) -> Optional[dict]:
+        return self.deltas.get("histograms", {}).get(name)
+
+    def histogram_interval_percentile(self, name: str, p: float
+                                      ) -> Optional[float]:
+        """Estimated p-th percentile of THIS interval's observations,
+        interpolated over the bucket-count deltas (None when the
+        histogram saw nothing this interval). The full bucket grid comes
+        from the snapshot — delta dicts drop zero entries, and losing the
+        empty buckets must not shrink the interpolation range."""
+        d = self.histogram_delta(name)
+        if not d or not d.get("count"):
+            return None
+        full = (self.metrics.get(name) or {}).get("buckets") or d["buckets"]
+        bounds = sorted(_parse_le(k) for k in full)
+        counts = {_parse_le(k): v for k, v in d["buckets"].items()}
+        return _bucket_percentile(bounds, counts, p)
+
+
+def _parse_le(key: str) -> float:
+    if key == "le_inf":
+        return float("inf")
+    return float(key[3:])
+
+
+def _bucket_percentile(bounds, counts: Dict[float, float],
+                       p: float) -> float:
+    """Linear-interpolated percentile over per-bucket interval counts on
+    the histogram's FULL bound grid — the interval-windowed twin of
+    ``Histogram.percentile``. A rank landing in the +Inf overflow bucket
+    reports the largest finite bound of the grid (the interval kept no
+    max; anything smaller would understate — and an SLO ceiling below
+    that bound must breach, exactly the slow-death case)."""
+    total = sum(counts.values())
+    if total <= 0:
+        return 0.0
+    rank = max(1.0, total * min(max(p, 0.0), 100.0) / 100.0)
+    largest_finite = max((b for b in bounds if b != float("inf")),
+                         default=0.0)
+    cum = 0.0
+    prev_bound = 0.0
+    for bound in bounds:
+        c = counts.get(bound, 0)
+        if c:
+            if rank <= cum + c:
+                if bound == float("inf"):
+                    return largest_finite
+                frac = (rank - cum) / c
+                return prev_bound + (bound - prev_bound) * frac
+            cum += c
+        if bound != float("inf"):
+            prev_bound = bound
+    return largest_finite
+
+
+def _counter_values(snap: Dict[str, dict]) -> Dict[str, float]:
+    return {n: float(s.get("value", 0.0)) for n, s in snap.items()
+            if s.get("type") == "counter"}
+
+
+def _hist_state(snap: Dict[str, dict]) -> Dict[str, dict]:
+    return {n: {"count": s.get("count", 0), "sum": s.get("sum", 0.0),
+                "buckets": dict(s.get("buckets", {}))}
+            for n, s in snap.items() if s.get("type") == "histogram"}
+
+
+class TelemetryExporter:
+    """The background snapshot→JSONL-ring thread (module docstring).
+
+    Construct directly for tests/tools (``interval_s`` etc. override the
+    env defaults); production surfaces go through :func:`acquire` /
+    :func:`release` so one process shares one exporter.
+    """
+
+    def __init__(self, dirpath: str, interval_s: Optional[float] = None,
+                 rotate_samples: Optional[int] = None,
+                 keep_files: Optional[int] = None,
+                 prometheus_file: Optional[bool] = None):
+        self.dir = dirpath
+        self.interval_s = (interval_s if interval_s is not None else
+                           _env_float("PADDLE_TPU_TELEMETRY_INTERVAL_S", 1.0))
+        self.rotate_samples = max(1, rotate_samples if rotate_samples
+                                  is not None else
+                                  _env_int("PADDLE_TPU_TELEMETRY_ROTATE", 512))
+        self.keep_files = max(1, keep_files if keep_files is not None else
+                              _env_int("PADDLE_TPU_TELEMETRY_KEEP", 4))
+        self.prometheus_file = (
+            prometheus_file if prometheus_file is not None
+            else _env_int("PADDLE_TPU_TELEMETRY_PROM", 1) != 0)
+        self.disabled = False        # disk export off after a write error
+        self.closed = False
+        self._refs = 0               # managed by module acquire()/release()
+        self._listeners: List[Callable[[TelemetrySample], None]] = []
+        self._lock = threading.Lock()       # tick serialization
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._seq = 0
+        self._file_idx = 0
+        self._samples_in_file = 0
+        snap = _mx.snapshot()
+        self._prev_counters = _counter_values(snap)
+        self._prev_hists = _hist_state(snap)
+        self._prev_gauges = {n: float(d.get("value", 0.0))
+                             for n, d in snap.items()
+                             if d.get("type") == "gauge"}
+        self._last_t = time.time()
+
+    # -- listeners ------------------------------------------------------------
+    def add_listener(self, fn: Callable[[TelemetrySample], None]) -> None:
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn: Callable[[TelemetrySample], None]) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
+    # -- the tick -------------------------------------------------------------
+    def tick(self) -> TelemetrySample:
+        """One export cycle: delta, append, rotate, notify. Public so
+        tests and ``--watch`` tooling can drive ticks deterministically;
+        the background thread calls exactly this."""
+        with self._lock:
+            now = time.time()
+            snap = _mx.snapshot()
+            counters = _counter_values(snap)
+            hists = _hist_state(snap)
+            gauges = {n: float(s.get("value", 0.0))
+                      for n, s in snap.items() if s.get("type") == "gauge"}
+            deltas: Dict[str, Any] = {"counters": {}, "histograms": {},
+                                      "gauges": {}}
+            for name, v in gauges.items():
+                if v != self._prev_gauges.get(name):
+                    deltas["gauges"][name] = v
+            for name, v in counters.items():
+                d = v - self._prev_counters.get(name, 0.0)
+                if d < 0:
+                    # counter went backwards = a mid-run metrics.reset():
+                    # Prometheus rate() semantics — treat the current value
+                    # as the whole interval's increment, never emit a
+                    # negative delta (which would fake SLO breaches)
+                    d = v
+                if d:
+                    deltas["counters"][name] = d
+            for name, h in hists.items():
+                prev = self._prev_hists.get(
+                    name, {"count": 0, "sum": 0.0, "buckets": {}})
+                if h["count"] < prev["count"] or any(
+                        h["buckets"].get(k, 0) < c
+                        for k, c in prev["buckets"].items()):
+                    # a shrinking total OR any shrinking bucket = a mid-run
+                    # metrics.reset(): restart the window from zero
+                    prev = {"count": 0, "sum": 0.0, "buckets": {}}
+                dc = h["count"] - prev["count"]
+                if dc:
+                    deltas["histograms"][name] = {
+                        "count": dc,
+                        "sum": h["sum"] - prev["sum"],
+                        "buckets": {
+                            k: v - prev["buckets"].get(k, 0)
+                            for k, v in h["buckets"].items()
+                            if v - prev["buckets"].get(k, 0)},
+                    }
+            self._seq += 1
+            sample = TelemetrySample(self._seq, now,
+                                     max(0.0, now - self._last_t),
+                                     snap, deltas)
+            self._prev_counters = counters
+            self._prev_hists = hists
+            self._prev_gauges = gauges
+            self._last_t = now
+            self._write(sample)
+            listeners = list(self._listeners)
+        _c_samples.inc()
+        for fn in listeners:
+            try:
+                fn(sample)
+            except Exception:
+                _log.exception("telemetry listener failed (ignored)")
+        return sample
+
+    # -- ring file management -------------------------------------------------
+    def _path(self, idx: int) -> str:
+        return os.path.join(self.dir,
+                            "telemetry_%d_%06d.jsonl" % (os.getpid(), idx))
+
+    def _write(self, sample: TelemetrySample) -> None:
+        if self.disabled:
+            return
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            if self._samples_in_file >= self.rotate_samples:
+                self._file_idx += 1
+                self._samples_in_file = 0
+                _c_rotations.inc()
+                self._prune()
+            path = self._path(self._file_idx)
+            with open(path, "a") as f:
+                f.write(json.dumps(sample.to_doc(), default=str) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            self._samples_in_file += 1
+            if self.prometheus_file:
+                # per-pid temp so concurrent multi-process exporters can't
+                # interleave writes; each atomic replace publishes a
+                # complete, self-consistent exposition (last writer wins)
+                tmp = os.path.join(self.dir,
+                                   ".metrics.prom.%d.tmp" % os.getpid())
+                with open(tmp, "w") as f:
+                    f.write(_mx.to_prometheus())
+                os.replace(tmp, os.path.join(self.dir, "metrics.prom"))
+        except OSError as e:
+            # the flight-recorder rule: a broken telemetry dir must never
+            # mask the run it observes — log once, keep listeners alive
+            self.disabled = True
+            _c_write_errors.inc()
+            _log.error(
+                "telemetry: cannot write to PADDLE_TPU_TELEMETRY_DIR=%r "
+                "(%s) — on-disk export disabled for this exporter; SLO "
+                "evaluation continues in-process", self.dir, e)
+
+    def _prune(self) -> None:
+        mine = sorted(glob.glob(
+            os.path.join(self.dir, "telemetry_%d_*.jsonl" % os.getpid())))
+        excess = len(mine) + 1 - self.keep_files  # +1: the file about to open
+        for path in mine[:max(0, excess)]:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "TelemetryExporter":
+        if self._thread is not None or self.closed:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.tick()
+                except Exception:
+                    _log.exception("telemetry tick failed (ignored)")
+
+        self._thread = threading.Thread(target=loop, name="tpu-telemetry",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, flush: bool = True) -> None:
+        """Stop the thread; ``flush`` writes the final PARTIAL interval so
+        activity since the last periodic tick is never lost."""
+        if self.closed:
+            return
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=max(5.0, 2 * self.interval_s))
+            self._thread = None
+        if flush:
+            try:
+                self.tick()
+            except Exception:
+                _log.exception("telemetry final flush failed (ignored)")
+        self.closed = True
+
+    # convenience: context manager for tests/tools
+    def __enter__(self) -> "TelemetryExporter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+# -- refcounted process singleton ---------------------------------------------
+
+_singleton_lock = threading.Lock()
+_exporter: Optional[TelemetryExporter] = None
+
+
+def acquire() -> Optional[TelemetryExporter]:
+    """Refcounted handle on the process exporter; ``None`` (one env read)
+    when ``PADDLE_TPU_TELEMETRY_DIR`` is unset. The first acquire starts
+    the thread; a second engine/supervisor in the same process shares it
+    instead of double-starting. The refcount lives ON the exporter, so a
+    mid-run dir change starts a fresh exporter for new acquirers while
+    existing holders keep theirs alive until their own release."""
+    d = os.environ.get("PADDLE_TPU_TELEMETRY_DIR", "").strip()
+    if not d:
+        return None
+    global _exporter
+    with _singleton_lock:
+        if _exporter is None or _exporter.closed or _exporter.dir != d:
+            _exporter = TelemetryExporter(d).start()
+        _exporter._refs += 1
+        return _exporter
+
+
+def release(handle: Optional[TelemetryExporter]) -> None:
+    """Drop one reference on ``handle``; the LAST release of an exporter
+    stops its thread and flushes the final partial interval — even for an
+    exporter superseded by a dir change, whose remaining holders keep
+    receiving ticks until they release. ``release(None)`` is a no-op so
+    callers can pair it unconditionally with :func:`acquire`."""
+    if handle is None:
+        return
+    global _exporter
+    with _singleton_lock:
+        handle._refs -= 1
+        if handle._refs > 0:
+            return
+        if handle is _exporter:
+            _exporter = None
+    handle.stop()
+
+
+def active_exporter() -> Optional[TelemetryExporter]:
+    return _exporter
+
+
+def force_tick() -> Optional[TelemetrySample]:
+    """Synchronously run one export tick on the live exporter (None when
+    no exporter is active) — the deterministic hook tests and the SLO
+    drills use instead of sleeping for the interval."""
+    exp = _exporter
+    return exp.tick() if exp is not None and not exp.closed else None
+
+
+# -- read-back ----------------------------------------------------------------
+
+def read_series(dirpath: str, pid: Optional[int] = None) -> List[dict]:
+    """Load the JSONL ring back as a list of sample docs ordered by
+    (file index, line order). ``pid=None`` reads every process's files
+    (multi-process jobs write disjoint names). Torn trailing lines (a
+    crash mid-append) are skipped, not fatal — the ring is a post-mortem
+    artifact first."""
+    pat = ("telemetry_%d_*.jsonl" % pid) if pid is not None \
+        else "telemetry_*_*.jsonl"
+
+    def _key(path):
+        m = re.search(r"telemetry_(\d+)_(\d+)\.jsonl$", path)
+        return (int(m.group(1)), int(m.group(2))) if m else (0, 0)
+
+    out: List[dict] = []
+    for path in sorted(glob.glob(os.path.join(dirpath, pat)), key=_key):
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        doc = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail line
+                    if doc.get("schema") == SAMPLE_SCHEMA:
+                        out.append(doc)
+        except OSError:
+            continue
+    return out

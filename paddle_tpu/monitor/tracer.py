@@ -37,6 +37,7 @@ __all__ = [
     "span", "start_tracing", "stop_tracing", "active", "get_spans",
     "clear_spans", "save_spans", "load_spans", "to_chrome_trace",
     "save_chrome_trace", "SPAN_SCHEMA",
+    "virtual_track", "record_span", "record_instant",
 ]
 
 SPAN_SCHEMA = "paddle_tpu.host_spans/v1"
@@ -46,6 +47,15 @@ _spans: List[Dict[str, Any]] = []
 _spans_lock = threading.Lock()
 _tls = threading.local()  # per-thread nesting depth
 _trace_file: Optional[str] = None
+
+# Virtual tracks: named synthetic (pid, tid) rows for spans whose natural
+# grouping is NOT the emitting thread — e.g. one Chrome-trace row per
+# serving batch slot, regardless of which host thread drove the engine.
+# Synthetic tids count down from -1 so they can never collide with real
+# thread idents (which are non-negative).
+_track_ids: Dict[str, int] = {}
+_track_names: Dict[int, str] = {}
+_next_track = [-1]
 
 # Whole-process tracing (PADDLE_TPU_TRACE_FILE) on a long-running job must
 # not grow memory without bound: past this cap new spans are dropped (count
@@ -164,6 +174,64 @@ def instant(name: str, cat: str = "host", args: Optional[dict] = None) -> None:
 __all__.append("instant")
 
 
+def virtual_track(name: str) -> int:
+    """Stable synthetic tid for a named trace row (``"serving slot 3"``).
+    The name lands in the Chrome trace's ``thread_name`` metadata so
+    Perfetto shows a labeled track instead of a thread id."""
+    with _spans_lock:
+        tid = _track_ids.get(name)
+        if tid is None:
+            tid = _next_track[0]
+            _next_track[0] -= 1
+            _track_ids[name] = tid
+            _track_names[tid] = name
+        return tid
+
+
+def record_span(name: str, ts_us: int, dur_us: int, cat: str = "host",
+                track: Optional[str] = None,
+                args: Optional[dict] = None) -> None:
+    """Record a complete span with EXPLICIT timestamps (µs on the
+    ``time.perf_counter`` clock — the same clock :func:`span` uses, so
+    mixed implicit/explicit spans stay on one timeline). ``track`` routes
+    the span onto a named virtual row (see :func:`virtual_track`) instead
+    of the calling thread. The serving request tracer reconstructs
+    request lifecycles from wall-clock timestamps through this."""
+    if not _active:
+        return
+    tid = virtual_track(track) if track is not None else None
+    rec = {
+        "name": name,
+        "cat": cat,
+        "ts_us": int(ts_us),
+        "dur_us": max(0, int(dur_us)),
+        "pid": os.getpid(),
+        "tid": tid if tid is not None else threading.get_ident(),
+        "depth": 0,
+    }
+    if track is not None:
+        # the label rides the span record itself, so a raw-span file
+        # converted in ANOTHER process (tools/dump_metrics --to-chrome)
+        # still renders named tracks, not synthetic tids
+        rec["track"] = track
+    if args:
+        rec["args"] = args
+    global _dropped
+    with _spans_lock:
+        if len(_spans) >= _max_spans:
+            _dropped += 1
+        else:
+            _spans.append(rec)
+
+
+def record_instant(name: str, ts_us: int, cat: str = "host",
+                   track: Optional[str] = None,
+                   args: Optional[dict] = None) -> None:
+    """Explicit-timestamp zero-duration marker on an optional virtual
+    track (terminal request states in the serving trace)."""
+    record_span(name, ts_us, 0, cat=cat, track=track, args=args)
+
+
 # -- serialization ------------------------------------------------------------
 
 def save_spans(path: str, spans: Optional[List[dict]] = None) -> str:
@@ -181,16 +249,25 @@ def load_spans(path: str) -> List[dict]:
         return list(doc.get("spans", []))
     if isinstance(doc, dict) and "traceEvents" in doc:
         # accept a Chrome trace back (the dump_metrics round-trip): complete
-        # events AND instant markers survive; only metadata ("M") is
-        # regenerated on the next export
+        # events AND instant markers survive; metadata ("M") is regenerated
+        # on the next export, with virtual-track labels re-attached from the
+        # thread_name metadata so named rows survive repeated conversions
+        labels = {}
+        for ev in doc["traceEvents"]:
+            if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+                name = (ev.get("args") or {}).get("name", "")
+                if not name.startswith("host-thread-"):
+                    labels[(ev.get("pid", 0), ev.get("tid", 0))] = name
         spans = []
         for ev in doc["traceEvents"]:
             if ev.get("ph") not in ("X", "i", "I"):
                 continue
+            track = labels.get((ev.get("pid", 0), ev.get("tid", 0)))
             spans.append({
                 "name": ev.get("name", ""), "cat": ev.get("cat", "host"),
                 "ts_us": int(ev.get("ts", 0)), "dur_us": int(ev.get("dur", 0)),
                 "pid": ev.get("pid", 0), "tid": ev.get("tid", 0),
+                **({"track": track} if track else {}),
                 **({"args": ev["args"]} if ev.get("args") else {}),
             })
         return spans
@@ -207,8 +284,12 @@ def to_chrome_trace(spans: Optional[List[dict]] = None) -> dict:
         pid, tid = s.get("pid", 0), s.get("tid", 0)
         if (pid, tid) not in seen_threads:
             seen_threads.add((pid, tid))
+            label = s.get("track")
+            if label is None:
+                with _spans_lock:
+                    label = _track_names.get(tid, "host-thread-%s" % tid)
             events.append({"ph": "M", "name": "thread_name", "pid": pid,
-                           "tid": tid, "args": {"name": "host-thread-%s" % tid}})
+                           "tid": tid, "args": {"name": label}})
         ev = {
             "ph": "X" if s.get("dur_us", 0) else "i",
             "name": s.get("name", ""),

@@ -37,7 +37,7 @@ import threading
 import time
 from typing import Any, Callable, List, Optional, Sequence
 
-from ..monitor import device as _dev, metrics as _mx
+from ..monitor import device as _dev, metrics as _mx, telemetry as _telemetry
 from . import faults as _faults
 
 __all__ = ["EXIT_PREEMPTED", "SupervisorResult", "run_supervised"]
@@ -144,6 +144,12 @@ def run_supervised(
     last_ckpt_step = start
     last_ckpt_t = time.monotonic()
     fr = _dev.flight_recorder()
+    # continuous telemetry rides the supervised run's lifetime: the JSONL
+    # ring streams while training, and the final release (in the finally
+    # below) flushes the last PARTIAL interval so a preempted or failed
+    # run still leaves a complete series (PADDLE_TPU_TELEMETRY_DIR unset
+    # = one env read, telemetry_handle stays None).
+    telemetry_handle = _telemetry.acquire()
     try:
         while res.steps_done < total_steps and not preempt_flag.is_set():
             want = min(k, total_steps - res.steps_done)
@@ -212,6 +218,7 @@ def run_supervised(
                                 step=res.steps_done,
                                 serial=res.last_serial)
     finally:
+        _telemetry.release(telemetry_handle)
         for sig, prev in installed:
             signal.signal(sig, prev)
 

@@ -27,11 +27,13 @@ Quick start::
     reqs = [eng.submit([1, 2, 3], max_new_tokens=16) for _ in range(32)]
     eng.run()                 # drains queue+slots, continuous batching
     print(reqs[0].tokens_out, reqs[0].latency_s)
+    eng.close()               # releases the continuous-telemetry exporter
 
 Benchmarks: ``python bench.py --serve`` (ragged continuous batching vs the
 padded static baseline), ``python -m tools.serve_bench --selftest``.
 """
 
+from . import trace  # noqa: F401
 from .engine import ServingConfig, ServingEngine  # noqa: F401
 from .kv_cache import ContiguousKVCache, PagedKVCache  # noqa: F401
 from .page_pool import PagePool, PagePoolExhausted  # noqa: F401
@@ -45,4 +47,5 @@ __all__ = [
     "PagePool", "PagePoolExhausted",
     "Scheduler", "Request", "BackpressureError",
     "QUEUED", "RUNNING", "FINISHED", "TIMEOUT", "FAILED",
+    "trace",
 ]

@@ -23,6 +23,7 @@ in-flight batch spec on any decode failure (``PADDLE_TPU_FLIGHT_DIR``).
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -31,9 +32,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..executor import _safe_flight_dump, aot_compile
-from ..monitor import device as _dev
+from ..monitor import device as _dev, slo as _slo, telemetry as _telemetry
 from ..reliability import faults as _faults
 from . import metrics as _sm
+from . import trace as _trace
 from .kv_cache import ContiguousKVCache, PagedKVCache
 from .page_pool import PagePool, PagePoolExhausted
 from .request import FAILED, FINISHED, TIMEOUT, Request
@@ -71,6 +73,15 @@ class ServingConfig:
     on a fatal failure — the in-flight batch is FAILED, its pages return to
     the pool, and the engine keeps serving the queue. ``fail_fast=True``
     restores the old raise-through behavior (debugging).
+
+    Telemetry: ``slos`` is an optional sequence of
+    :class:`paddle_tpu.monitor.slo.SLO` specs evaluated on every telemetry
+    export tick (``PADDLE_TPU_TELEMETRY_DIR`` arms the exporter; the
+    engine starts/stops it with its own lifetime). A breached spec with
+    ``degrade=True`` flips :meth:`ServingEngine.health` to ``degraded``
+    until a clean tick — slow-death becomes visible to the same recovery
+    ladder that sees exceptions. ``PADDLE_TPU_SLO`` (see
+    :func:`paddle_tpu.monitor.slo.parse_slos`) appends env-declared specs.
     """
 
     def __init__(self, slots: int = 8, page_size: int = 16,
@@ -80,7 +91,8 @@ class ServingConfig:
                  decode_fuse: int = 1, paged: bool = True,
                  continuous: bool = True, collect_logits: bool = False,
                  pad_id: int = 0, decode_retries: int = 2,
-                 fail_fast: bool = False):
+                 fail_fast: bool = False,
+                 slos: Optional[Sequence] = None):
         if max_seq % page_size != 0:
             raise ValueError("max_seq=%d must be a multiple of page_size=%d"
                              % (max_seq, page_size))
@@ -104,6 +116,7 @@ class ServingConfig:
         self.pad_id = int(pad_id)
         self.decode_retries = max(0, int(decode_retries))
         self.fail_fast = bool(fail_fast)
+        self.slos = list(slos) if slos else []
 
 
 class ServingEngine:
@@ -154,8 +167,60 @@ class ServingEngine:
         self._consecutive_failures = 0
         self._faults_absorbed = 0
         self._last_error: Optional[str] = None
+        self._closed = False
+        # continuous telemetry: refcounted process exporter (None when
+        # PADDLE_TPU_TELEMETRY_DIR is unset — that check is one env read)
+        self._telemetry = _telemetry.acquire()
+        self._slo_breach: Optional[_slo.Breach] = None
+        self._slo_monitor: Optional[_slo.SLOMonitor] = None
+        specs = list(self.cfg.slos)
+        env_slos = os.environ.get("PADDLE_TPU_SLO", "").strip()
+        if env_slos:
+            specs.extend(_slo.parse_slos(env_slos))
+        if specs:
+            self._slo_monitor = _slo.SLOMonitor(
+                specs, on_breach=self._on_slo_breach,
+                on_clear=self._on_slo_clear)
+            if self._telemetry is not None:
+                self._telemetry.add_listener(self._slo_monitor.on_sample)
+            else:
+                # SLOs only evaluate on export ticks: without the exporter
+                # they would be silently dead — say so once, loudly
+                import logging
+
+                logging.getLogger("paddle_tpu").warning(
+                    "ServingEngine: %d SLO spec(s) configured but "
+                    "PADDLE_TPU_TELEMETRY_DIR is unset — no export ticks "
+                    "will run, so the SLOs are inert (health() cannot "
+                    "degrade on them)", len(specs))
 
     # -- public API -----------------------------------------------------------
+    def close(self) -> None:
+        """Release the engine's telemetry resources: unhook the SLO
+        monitor and drop the exporter reference (the LAST engine or
+        supervisor releasing it stops the thread and flushes the final
+        partial interval). Idempotent; compiled executables stay usable."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._telemetry is not None:
+            if self._slo_monitor is not None:
+                self._telemetry.remove_listener(self._slo_monitor.on_sample)
+            _telemetry.release(self._telemetry)
+            self._telemetry = None
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _on_slo_breach(self, breach) -> None:
+        self._slo_breach = breach
+
+    def _on_slo_clear(self) -> None:
+        self._slo_breach = None
+
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                deadline_s: Optional[float] = None) -> Request:
         """Queue a request. Raises ``ValueError`` for a request that can
@@ -179,7 +244,9 @@ class ServingEngine:
             raise ValueError(
                 "request needs %d pages but the pool only has %d"
                 % (self.pool.pages_needed(total), self.pool.num_pages))
-        return self.scheduler.submit(req)
+        req = self.scheduler.submit(req)
+        _trace.on_submitted(req)
+        return req
 
     def step(self) -> List[Request]:
         """One multiplexer cycle: expire deadlines, retire/admit into free
@@ -229,9 +296,14 @@ class ServingEngine:
         """Liveness/degradation snapshot for an external health checker:
         ``status`` is ``"ok"`` until a decode failure is absorbed and back
         to ``"ok"`` after the next clean dispatch (``"degraded"`` in
-        between). Counters are lifetime totals for THIS engine."""
+        between) — and, with SLO specs configured, while the most recent
+        telemetry tick breached a ``degrade=True`` spec (slow-death
+        detection, cleared by the next healthy tick). Counters are
+        lifetime totals for THIS engine."""
+        degraded = bool(self._consecutive_failures) or \
+            self._slo_breach is not None
         out = {
-            "status": "degraded" if self._consecutive_failures else "ok",
+            "status": "degraded" if degraded else "ok",
             "queued": self.scheduler.queue_depth,
             "running": self.scheduler.occupancy,
             "consecutive_failures": self._consecutive_failures,
@@ -239,6 +311,10 @@ class ServingEngine:
             "last_error": self._last_error,
             "page_accounting_ok": self.page_accounting_ok(),
         }
+        if self._slo_breach is not None:
+            out["slo_breach"] = self._slo_breach.to_doc()
+        if self._slo_monitor is not None:
+            out["slo_breaches_total"] = self._slo_monitor.breaches_total
         if self.pool is not None:
             out["pages_free"] = self.pool.num_free
             out["pages_total"] = self.pool.num_pages
@@ -296,6 +372,7 @@ class ServingEngine:
             req = self.scheduler.admit(slot)
             req.admitted_t = time.perf_counter()
             req.pages = pages
+            _trace.on_admitted(req, slot)
             bucket = wave_bucket or self._bucket_for(req.prompt_len)
             done = self._prefill(req, slot, bucket)
             if done is not None:
@@ -321,7 +398,9 @@ class ServingEngine:
             self.params, self._cache, dest, jnp.asarray(prompt),
             jnp.asarray(req.prompt_len, jnp.int32))
         tok = int(np.asarray(first_tok))
-        _sm.PREFILL_MS.observe((time.perf_counter() - t0) * 1e3)
+        t1 = time.perf_counter()
+        _trace.on_prefill(req, slot, bucket, t0, t1)
+        _sm.PREFILL_MS.observe((t1 - t0) * 1e3)
         _sm.PREFILL_COUNT.inc()
         _sm.TOKENS_GENERATED.inc()
         now = time.perf_counter()
@@ -415,7 +494,11 @@ class ServingEngine:
                     raise
                 return self._fail_inflight_batch(e)
         self._consecutive_failures = 0
-        _sm.DECODE_STEP_MS.observe((time.perf_counter() - t0) * 1e3)
+        t1 = time.perf_counter()
+        _trace.on_decode_chunk(
+            [self.scheduler.slot_request(s) for s in range(self.cfg.slots)],
+            fuse, t0, t1)
+        _sm.DECODE_STEP_MS.observe((t1 - t0) * 1e3)
         _sm.DECODE_DISPATCHES.inc()
         _sm.DECODE_STEPS.inc(fuse)
         _sm.TOKENS_GENERATED.inc(int(emitted.sum()))
@@ -447,6 +530,7 @@ class ServingEngine:
             self.pool.free(req.pages)
             req.pages = []
         req.finished_t = time.perf_counter()
+        _trace.on_terminal(req, state, slot)
         if state == FINISHED:
             _sm.REQUEST_LATENCY_MS.observe(
                 (req.finished_t - req.submitted_t) * 1e3)
@@ -468,6 +552,7 @@ class ServingEngine:
         out: List[Request] = []
         for req in self.scheduler.drop_expired(now):
             req.finished_t = now
+            _trace.on_terminal(req, TIMEOUT, None)
             _sm.TIMEOUTS.inc()
             out.append(req)
         for slot in range(self.cfg.slots):
@@ -513,6 +598,7 @@ class ServingEngine:
             if req is None:
                 continue
             rows.append({"slot": slot, "request_id": req.id,
+                         "trace_id": req.trace_id,
                          "prompt_len": req.prompt_len,
                          "generated": len(req.tokens_out),
                          "max_new_tokens": req.max_new_tokens,

@@ -48,7 +48,7 @@ class Request:
 
     __slots__ = ("id", "prompt", "max_new_tokens", "state", "slot", "pages",
                  "tokens_out", "submitted_t", "admitted_t", "first_token_t",
-                 "finished_t", "deadline_s", "error")
+                 "finished_t", "deadline_s", "error", "trace_id")
 
     def __init__(self, prompt: Sequence[int], max_new_tokens: int,
                  deadline_s: Optional[float] = None):
@@ -59,6 +59,10 @@ class Request:
         if deadline_s is not None and deadline_s < 0:
             raise ValueError("deadline_s must be >= 0")
         self.id = next(_ids)
+        # The per-request trace identity: spans in the serving timeline and
+        # flight-recorder batch specs carry it, so a crash dump links back
+        # to the exact request lifelines in the Perfetto trace.
+        self.trace_id = "req-%d" % self.id
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.state = QUEUED

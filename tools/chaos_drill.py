@@ -172,6 +172,7 @@ def drill_serving() -> None:
     for r in reqs:
         if r.state == "failed":
             assert r.error and not r.pages, r
+    eng.close()
 
     # pool exhaustion: injected at alloc -> admission backpressures (the
     # request queues), pages retire, everything completes
@@ -189,6 +190,7 @@ def drill_serving() -> None:
     assert eng2.pool.num_used == 0 and eng2.page_accounting_ok()
     blocked = mx.snapshot()["serving/admission_blocked_on_pages"]["value"]
     assert blocked > blocked0, "injected exhaustion never backpressured"
+    eng2.close()
 
     # deadline ladder: an expired request is retired TIMEOUT, not served
     eng3 = serving.ServingEngine(model, serving.ServingConfig(
@@ -196,6 +198,7 @@ def drill_serving() -> None:
     late = eng3.submit([1, 2, 3], 4, deadline_s=0.0)
     ok = eng3.submit([1, 2, 3], 4)
     eng3.run(max_steps=100)
+    eng3.close()
     assert late.state == "timeout" and ok.state == "finished", \
         (late.state, ok.state)
     snap = mx.snapshot()

@@ -12,10 +12,20 @@ The tools/timeline.py of this stack, plus a metrics pretty-printer:
         existing Chrome trace too (idempotent), so the conversion
         round-trips.
 
+    python -m tools.dump_metrics --watch <interval_s>
+        Tail the LIVE in-process registry as interval deltas: every tick
+        print counters that moved (as +delta and rate/s), gauges that
+        changed, and histogram activity. Ctrl-C exits. (Most useful from
+        code: ``from tools.dump_metrics import watch; watch(1.0)`` in a
+        thread next to a running engine — a separate process sees its own
+        registry, so there it tails a telemetry ring dir instead:
+        ``--watch <interval_s> <PADDLE_TPU_TELEMETRY_DIR>``.)
+
     python -m tools.dump_metrics --selftest
-        Exercise registry + tracer + the Chrome-trace round-trip
-        in-process and exit 0/1. Needs no TPU (run under
-        ``JAX_PLATFORMS=cpu``); the CI smoke check.
+        Exercise registry + tracer + the Chrome-trace round-trip +
+        telemetry ring write/rotate/read-back + SLO counters in-process
+        and exit 0/1. Needs no TPU (run under ``JAX_PLATFORMS=cpu``); the
+        CI smoke check.
 """
 
 from __future__ import annotations
@@ -66,6 +76,84 @@ def to_chrome(src: str, dst: str) -> int:
     spans = tracer.load_spans(src)
     tracer.save_chrome_trace(dst, spans)
     print("wrote %d span(s) -> %s" % (len(spans), dst))
+    return 0
+
+
+def _delta_lines(sample) -> list:
+    """One human line per instrument that moved this interval (the
+    exporter's own ``telemetry/*`` bookkeeping is excluded — every tick
+    moves it, which would bury real deltas and make idle look busy)."""
+    lines = []
+    for name, d in sorted(sample.deltas.get("counters", {}).items()):
+        if name.startswith("telemetry/"):
+            continue
+        lines.append("%-44s +%-10g %8.2f/s"
+                     % (name, d, d / sample.dt_s if sample.dt_s else 0.0))
+    for name, v in sorted(sample.deltas.get("gauges", {}).items()):
+        if name.startswith("telemetry/"):
+            continue
+        lines.append("%-44s -> %g" % (name, v))
+    for name, h in sorted(sample.deltas.get("histograms", {}).items()):
+        p99 = sample.histogram_interval_percentile(name, 99) or 0.0
+        lines.append("%-44s n=%-6d mean=%.3f p99=%.3f"
+                     % (name, h["count"],
+                        (h["sum"] / h["count"]) if h["count"] else 0.0, p99))
+    return lines
+
+
+def watch(interval_s: float, telemetry_dir: str = None,
+          max_ticks: int = None) -> int:
+    """Print interval deltas every ``interval_s``. With ``telemetry_dir``
+    set, tail another process's JSONL telemetry ring (the exporter's
+    output dir) instead of the local registry; otherwise run a private
+    in-process exporter with no disk ring. ``max_ticks`` bounds the loop
+    (tests); None = until KeyboardInterrupt. The ring tail re-parses the
+    whole (bounded: rotate × keep samples) ring each interval and filters
+    by per-writer seq — simple over fast, this is an ops tool."""
+    import time
+
+    from paddle_tpu.monitor import telemetry
+    from paddle_tpu.monitor.telemetry import TelemetrySample
+
+    ticks = 0
+    try:
+        if telemetry_dir:
+            # track the monotone per-writer seq, NOT the list index: a
+            # ring rotation prunes old files, shrinking the list without
+            # un-publishing samples (index tracking would go blind for a
+            # whole rotation's worth of samples after each prune)
+            last_seq = {}
+            while max_ticks is None or ticks < max_ticks:
+                for doc in telemetry.read_series(telemetry_dir):
+                    pid = doc.get("pid", 0)
+                    if doc.get("seq", 0) <= last_seq.get(pid, -1):
+                        continue
+                    last_seq[pid] = doc.get("seq", 0)
+                    sample = TelemetrySample(
+                        doc.get("seq", 0), doc.get("t", 0.0),
+                        doc.get("dt_s", 0.0), doc.get("metrics", {}),
+                        doc.get("deltas", {}))
+                    body = _delta_lines(sample)
+                    print("-- seq %d (dt %.2fs)" % (sample.seq, sample.dt_s))
+                    for line in body:
+                        print(line)
+                ticks += 1
+                time.sleep(interval_s)
+            return 0
+        exp = telemetry.TelemetryExporter(
+            "", interval_s=interval_s, prometheus_file=False)
+        exp.disabled = True  # live tail only — never writes a ring
+        while max_ticks is None or ticks < max_ticks:
+            time.sleep(interval_s)
+            sample = exp.tick()
+            body = _delta_lines(sample)
+            print("-- %s (dt %.2fs)"
+                  % (time.strftime("%H:%M:%S"), sample.dt_s))
+            for line in (body or ["(no activity)"]):
+                print(line)
+            ticks += 1
+    except KeyboardInterrupt:
+        pass
     return 0
 
 
@@ -250,6 +338,44 @@ def selftest() -> int:
                  "serving/requests_failed"):
         assert name in snap, "missing instrument %s" % name
     metrics.reset()
+
+    # 7. continuous telemetry: JSONL ring write/rotate/read-back, interval
+    #    deltas, the watch formatter, Prometheus rendering, and the slo/*
+    #    counters breaching + clearing on synthetic ticks
+    from paddle_tpu.monitor import slo, telemetry
+
+    metrics.reset()
+    with tempfile.TemporaryDirectory() as td:
+        exp = telemetry.TelemetryExporter(td, interval_s=999.0,
+                                          rotate_samples=2, keep_files=2)
+        h = metrics.histogram("selftest/lat_ms")
+        mon = slo.SLOMonitor([slo.SLO("selftest/lat_ms", p=99, max_ms=10.0)])
+        exp.add_listener(mon.on_sample)
+        for i in range(5):
+            h.observe(100.0 if i < 2 else 1.0)  # breach 2 ticks, then clear
+            sample = exp.tick()
+            assert sample.histogram_delta("selftest/lat_ms")["count"] == 1
+            assert _delta_lines(sample)  # the --watch formatter must render
+        exp.stop()  # final flush = one more (empty-delta) sample
+        series = telemetry.read_series(td, pid=os.getpid())
+        assert len(series) >= 2, "ring rotation lost everything: %d" % len(series)
+        assert all(s["schema"] == telemetry.SAMPLE_SCHEMA for s in series)
+        seqs = [s["seq"] for s in series]
+        assert seqs == sorted(seqs) and seqs[-1] == 6, seqs
+        files = [f for f in os.listdir(td) if f.endswith(".jsonl")]
+        assert len(files) <= 2, "rotation did not prune: %s" % files
+        assert os.path.exists(os.path.join(td, "metrics.prom"))
+        snap = metrics.snapshot()
+        assert snap["slo/breaches"]["value"] == 2, snap["slo/breaches"]
+        assert snap["telemetry/samples"]["value"] == 6
+        assert snap["telemetry/rotations"]["value"] >= 1
+        assert "slo/selftest/lat_ms:p99/breaches" in snap
+    # prometheus exposition must carry the histogram triplet, sanitized
+    prom = metrics.to_prometheus()
+    assert "selftest_lat_ms_bucket{le=\"+Inf\"}" in prom, prom[-400:]
+    assert "selftest_lat_ms_count 5" in prom
+    assert "selftest_lat_ms_sum" in prom
+    metrics.reset()
     print("dump_metrics selftest: OK")
     return 0
 
@@ -267,6 +393,12 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         return to_chrome(argv[1], argv[2])
+    if argv[0] == "--watch":
+        if len(argv) not in (2, 3):
+            print("usage: dump_metrics --watch <interval_s> [telemetry_dir]",
+                  file=sys.stderr)
+            return 2
+        return watch(float(argv[1]), argv[2] if len(argv) == 3 else None)
     if len(argv) != 1:
         print(__doc__.strip(), file=sys.stderr)
         return 2

@@ -30,8 +30,11 @@ MODULES = [
     "paddle_tpu.io",
     "paddle_tpu.metrics",
     "paddle_tpu.monitor",
+    "paddle_tpu.monitor.budgets",
     "paddle_tpu.monitor.device",
     "paddle_tpu.monitor.metrics",
+    "paddle_tpu.monitor.slo",
+    "paddle_tpu.monitor.telemetry",
     "paddle_tpu.monitor.tracer",
     "paddle_tpu.nets",
     "paddle_tpu.reader",

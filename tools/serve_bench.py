@@ -45,10 +45,12 @@ def make_stream(n_requests, vocab, max_prompt, max_new_hi, seed=0,
     return stream
 
 
-def drive(model, stream, scfg, warmup=True):
+def drive(model, stream, scfg, warmup=True, keep_open=False):
     """Submit ``stream`` to a fresh engine and drain it; returns the
     latency/throughput digest. Compiles are excluded from the timed region
-    via :meth:`ServingEngine.warmup` (steady-state serving numbers)."""
+    via :meth:`ServingEngine.warmup` (steady-state serving numbers).
+    ``keep_open=False`` closes the engine (releasing its telemetry
+    reference) before returning."""
     from paddle_tpu import serving
 
     eng = serving.ServingEngine(model, scfg)
@@ -58,6 +60,8 @@ def drive(model, stream, scfg, warmup=True):
     reqs = [eng.submit(p, m) for p, m in stream]
     done = eng.run()
     wall = time.perf_counter() - t0
+    if not keep_open:
+        eng.close()
     assert len(done) == len(reqs), "stream did not drain: %d/%d" % (
         len(done), len(reqs))
     lat_ms = sorted(1e3 * r.latency_s for r in reqs)
@@ -128,6 +132,16 @@ def serve_bench(n_requests=64, slots=8, vocab=512, n_layer=4, d_model=128,
             padded["cache_bytes"] - over["cache_bytes"])
     except Exception as e:  # the demo leg must never sink the headline
         out["continuous_paged_half_pool"] = {"error": repr(e)[:200]}
+    # observability artifact pointers for the summary tail: with
+    # PADDLE_TPU_TRACE_FILE set the per-request serving spans land in that
+    # Chrome trace at exit (open in Perfetto — one track per slot), and
+    # with PADDLE_TPU_TELEMETRY_DIR the run leaves a JSONL metrics series
+    trace_file = os.environ.get("PADDLE_TPU_TRACE_FILE", "").strip()
+    if trace_file:
+        out["trace_file"] = trace_file
+    telemetry_dir = os.environ.get("PADDLE_TPU_TELEMETRY_DIR", "").strip()
+    if telemetry_dir:
+        out["telemetry_dir"] = telemetry_dir
     return out
 
 
@@ -139,12 +153,20 @@ def _backend():
 
 def selftest() -> int:
     """Tiny decoder through prefill -> decode -> retire in-process, CPU,
-    <5s: the cheap CI gate for the serving stack."""
+    <5s: the cheap CI gate for the serving stack. Runs with the host
+    tracer on, so it also asserts the per-request span sets (every
+    terminal request complete + well-nested, no queued-without-terminal
+    orphans) across the FINISHED, TIMEOUT and FAILED paths."""
+    import tempfile
+
     from paddle_tpu import serving
     from paddle_tpu.models import decoder_lm
-    from paddle_tpu.monitor import metrics as mx
+    from paddle_tpu.monitor import metrics as mx, tracer
+    from paddle_tpu.serving import trace as strace
 
     t0 = time.perf_counter()
+    tracer.start_tracing()
+    all_reqs = []  # every request the drill creates, for span validation
     cfg = decoder_lm.DecoderConfig(vocab_size=64, n_layer=2, d_model=32,
                                    n_head=2, max_seq=64)
     model = decoder_lm.DecoderLM(cfg, seed=0)
@@ -155,6 +177,7 @@ def selftest() -> int:
     for _ in range(6):
         p = list(rng.randint(0, 64, int(rng.randint(3, 24))))
         reqs.append(eng.submit(p, int(rng.randint(2, 10))))
+    all_reqs.extend(reqs)
     done = eng.run()
     assert len(done) == 6, "drain incomplete: %d/6" % len(done)
     for r in reqs:
@@ -170,6 +193,7 @@ def selftest() -> int:
     assert health["status"] == "ok" and health["page_accounting_ok"], health
     # a deadline of 0 must be retired TIMEOUT without pinning slot or pages
     late = eng.submit([1, 2, 3], 4, deadline_s=0.0)
+    all_reqs.append(late)
     eng.run(max_steps=50)
     assert late.state == "timeout" and not late.pages, late
     assert eng.pool.num_used == 0 and eng.page_accounting_ok()
@@ -201,7 +225,34 @@ def selftest() -> int:
     except serving.BackpressureError:
         pass
     assert mx.snapshot()["serving/requests_rejected"]["value"] >= 1
-    print("serve_bench selftest: OK (%.1fs)" % (time.perf_counter() - t0))
+    eng2.close()
+    # FAILED path: a fatal injected decode failure evicts the in-flight
+    # batch — those requests must ALSO leave complete span sets (FAILED
+    # terminal), not orphans
+    from paddle_tpu.reliability import FaultPlan
+
+    failed_req = eng.submit(list(rng.randint(0, 64, 5)), 8)
+    all_reqs.append(failed_req)
+    with FaultPlan.parse("serving.decode@1=fatal"):
+        eng.run(max_steps=20)
+    assert failed_req.state == "failed", failed_req
+    assert eng.page_accounting_ok() and eng.pool.num_used == 0
+    eng.close()
+    # span-set validation over every terminal request of the drill, plus
+    # the written Chrome trace itself (the artifact a human opens)
+    spans = tracer.stop_tracing()
+    digests = strace.validate_request_spans(spans, all_reqs)
+    assert len(digests) == len(all_reqs), (len(digests), len(all_reqs))
+    assert digests[late.trace_id]["admitted"] is False
+    assert digests[failed_req.trace_id]["state"] == "failed"
+    admitted = sum(1 for d in digests.values() if d["admitted"])
+    by_slot = strace.slot_assignments_from_spans(spans)
+    assert sum(len(v) for v in by_slot.values()) == admitted, by_slot
+    trace_path = os.path.join(tempfile.gettempdir(),
+                              "serve_bench_trace_%d.json" % os.getpid())
+    tracer.save_chrome_trace(trace_path, spans)
+    print("serve_bench selftest: OK (%.1fs)  %d requests traced; "
+          "trace: %s" % (time.perf_counter() - t0, len(digests), trace_path))
     return 0
 
 
