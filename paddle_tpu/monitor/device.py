@@ -97,6 +97,18 @@ def profile_enabled() -> bool:
     return _env_on("PADDLE_TPU_DEVICE_PROFILE", "0")
 
 
+def raw_device_kind() -> str:
+    """``device_kind`` of the default backend's first device (e.g.
+    ``"TPU v5 lite"``, ``"cpu"``) — the microarchitecture identity that
+    keys tuned kernel configs (paddle_tpu.tune normalizes it)."""
+    import jax
+
+    try:
+        return jax.devices()[0].device_kind
+    except Exception:
+        return "unknown"
+
+
 # -- 1. compiled-step cost/memory attribution ---------------------------------
 
 _g_flops = _mx.gauge("device_profile/flops",

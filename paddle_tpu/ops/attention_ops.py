@@ -50,27 +50,61 @@ def _pick_block(s: int):
         "(the caller's gate should have rejected it)" % s)
 
 
-def _tuned_block_sizes(sq: int, sk: int):
-    """v5e-tuned tile sizes for the Pallas flash kernel.
+def _divisor_block(want: int, s: int, fallback: int) -> int:
+    """Largest power-of-two tile <= ``want`` that divides ``s`` (>=128);
+    ``fallback`` when none does. Tuned entries are bucketed coarsely, so a
+    512 tuned for s=8192 must legally serve s=384 by clamping to 128."""
+    b = 1 << (max(int(want), 128).bit_length() - 1)
+    while b >= 128:
+        if s % b == 0:
+            return b
+        b //= 2
+    return fallback
 
-    The stock ``BlockSizes.get_default()`` is all-128, which loses 0.63x to
-    XLA-composed attention at S=8192 (round-3 finding). A full (block_q x
-    block_k) sweep on the real v5e chip (benchmarks/sweep_flash_blocks.py,
-    round 4) found 512x512 optimal: 2.65 ms vs 17.2 ms default vs 12.8 ms
-    composed at b1 h8 s8192 d64 causal bf16 fwd+bwd — a 4.8x win. Larger
-    tiles amortize the grid/DMA overhead and keep the MXU fed; beyond 512
-    the VMEM working set starts thrashing. Blocks must divide the sequence
-    lengths, so shorter/ragged sequences fall back to the largest divisor.
-    """
+
+def _block_sizes_for(bq: int, bk: int):
+    """The (bq, bk) -> full BlockSizes mapping (fwd + both backward
+    kernels share the same tiles) — ONE definition, used by the trace-time
+    lookup below AND the autotuner's flash candidate builds, so tuned
+    entries are always measured under the exact block assignment they will
+    later serve."""
     from .pallas_kernels.flash_attention import BlockSizes
 
-    bq, bk = _pick_block(sq), _pick_block(sk)
     return BlockSizes(
         block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
         block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
         block_q_dkv=bq,
         block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq,
     )
+
+
+def _tuned_block_sizes(sq: int, sk: int):
+    """Tile sizes for the Pallas flash kernel: tuned table -> shipped
+    seeds -> hardcoded fallback (paddle_tpu.tune).
+
+    The hardcoded fallback encodes the round-4 hand sweep on the real v5e
+    chip (benchmarks/sweep_flash_blocks.py): 512x512 optimal — 2.65 ms vs
+    17.2 ms all-128 default vs 12.8 ms composed at b1 h8 s8192 d64 causal
+    bf16 fwd+bwd, a 4.8x win; larger tiles amortize grid/DMA overhead and
+    keep the MXU fed, beyond 512 the VMEM working set thrashes. The same
+    numbers now also live in ``tune/shipped.json`` keyed tpu-v5e, and
+    ``tools/autotune.py`` re-derives them per (shape-bucket, device_kind)
+    by measurement — so other device kinds get their own optimum instead
+    of inheriting v5e's. Blocks must divide the sequence lengths, so
+    tuned/shorter shapes clamp to the largest working divisor; a corrupt
+    or missing table silently yields the fallback (lookup never raises).
+    """
+    bq, bk = _pick_block(sq), _pick_block(sk)
+    try:
+        from .. import tune
+
+        cfg, _src = tune.lookup("flash_attention", tune.bucket_seq(sq, sk))
+        if cfg:
+            bq = _divisor_block(int(cfg.get("block_q", bq)), sq, bq)
+            bk = _divisor_block(int(cfg.get("block_k", bk)), sk, bk)
+    except Exception:  # table layer must never take down a training trace
+        pass
+    return _block_sizes_for(bq, bk)
 
 
 def _flash_ok(q, k, causal) -> bool:

@@ -102,10 +102,39 @@ def softmax_xent_supported(n: int, v: int, dtype) -> bool:
     return n >= 8 and v >= 128
 
 
-def _pad(logits, labels):
+def _shrink_tiles(n, v, bn, bv):
+    """Clamp requested tiles to the problem: small batches shrink the row
+    tile to the next power of two (>=8), small vocabs shrink the lane tile
+    to the 128-multiple cover."""
+    bn = bn if n >= bn else max(8, 1 << (n - 1).bit_length())
+    bv = bv if v >= bv else max(128, -(-v // 128) * 128)
+    return bn, bv
+
+
+def _tile_sizes(n, v):
+    """(bn, bv) for this shape: tuned table -> shipped -> the hardcoded
+    ``_BN``/``_BV`` defaults (paddle_tpu.tune, kernel key
+    ``softmax_xent``). Tuned values are sanitized to the sublane/lane
+    multiples the grid needs; the lookup never raises, so a corrupt table
+    degrades to the defaults."""
+    bn, bv = _BN, _BV
+    try:
+        from ...tune import table as _tt
+
+        cfg, _src = _tt.lookup("softmax_xent", _tt.bucket_nv(n, v))
+        if cfg:
+            bn = max(8, (int(cfg.get("block_n", bn)) // 8) * 8)
+            bv = max(128, (int(cfg.get("block_v", bv)) // 128) * 128)
+    except Exception:
+        bn, bv = _BN, _BV
+    return _shrink_tiles(n, v, bn, bv)
+
+
+def _pad_to(logits, labels, bn, bv):
+    """Pad [N, V] logits/labels out to the (bn, bv) grid: pad vocab lanes
+    carry ``_NEG`` so their exp underflows to exactly 0, pad rows are
+    harmless label-0 rows sliced off by the callers."""
     n, v = logits.shape
-    bn = _BN if n >= _BN else max(8, 1 << (n - 1).bit_length())
-    bv = _BV if v >= _BV else max(128, -(-v // 128) * 128)
     n_pad = -(-n // bn) * bn - n
     v_pad = -(-v // bv) * bv - v
     if v_pad:
@@ -113,6 +142,13 @@ def _pad(logits, labels):
     if n_pad:
         logits = jnp.pad(logits, ((0, n_pad), (0, 0)), constant_values=0.0)
         labels = jnp.pad(labels, ((0, n_pad), (0, 0)), constant_values=0)
+    return logits, labels, n_pad, v_pad
+
+
+def _pad(logits, labels):
+    n, v = logits.shape
+    bn, bv = _tile_sizes(n, v)
+    logits, labels, n_pad, v_pad = _pad_to(logits, labels, bn, bv)
     return logits, labels, bn, bv, n_pad, v_pad
 
 

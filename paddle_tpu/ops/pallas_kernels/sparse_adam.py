@@ -192,9 +192,28 @@ def _sgd_kernel(ids_ref, scal_ref, p_hbm, rows_ref, p_out, p_scr, sems,
     jax.lax.fori_loop(0, block, wait_write, 0)
 
 
-def _block_size(block, n_ids):
+def _block_size(block, n_ids, dim=None):
     """ids-per-grid-step, shrunk for small batches and rounded up to the
-    f32 sublane multiple so the VMEM scratch tiles cleanly."""
+    f32 sublane multiple so the VMEM scratch tiles cleanly.
+
+    ``block=None`` (the kernel entry points' default) consults the tuned
+    config table first (paddle_tpu.tune: shape-bucket + device_kind, with
+    the shipped v5e 128-id seed), falling back to the hardcoded ``_BLOCK``
+    — an explicit integer is always honored verbatim (modulo the rounding
+    below), which is what keeps the autotuner's own sweep from looping
+    through the table it is writing. The lookup never raises; a corrupt
+    table logs once inside tune.table and lands here as the default."""
+    if block is None:
+        block = _BLOCK
+        try:
+            from ...tune import table as _tt
+
+            cfg, _src = _tt.lookup(
+                "sparse_adam", _tt.bucket_rows(n_ids, dim or 1))
+            if cfg and int(cfg.get("block", 0)) > 0:
+                block = int(cfg["block"])
+        except Exception:
+            pass
     b = min(int(block), max(8, n_ids))
     return -(-b // 8) * 8
 
@@ -215,14 +234,16 @@ def _pad_ids_rows(ids, rows, vocab, block):
 
 def sparse_adam_rows(param, moment1, moment2, ids, rows, lr_t,
                      beta1=0.9, beta2=0.999, epsilon=1e-8,
-                     interpret: bool = False, block: int = _BLOCK):
+                     interpret: bool = False, block=None):
     """One-kernel lazy Adam over merged sparse rows.
 
     ``param``/``moment1``/``moment2``: [V, D] f32 tables (aliased in/out —
     untouched rows never move). ``ids``: [N] int32 merged unique row ids,
     padded entries == V. ``rows``: [N, D] f32 merged gradient rows.
     ``lr_t``: bias-corrected scalar step size ``lr·sqrt(1-β2^t)/(1-β1^t)``
-    (the same folding adam_op does). Returns (param, m, v) updated.
+    (the same folding adam_op does). ``block=None`` = tuned-table lookup
+    with the hardcoded 128 fallback (see ``_block_size``). Returns
+    (param, m, v) updated.
     """
     if pltpu is None:
         # the interpreter still needs the TPU grid-spec/memory-space objects
@@ -233,7 +254,7 @@ def sparse_adam_rows(param, moment1, moment2, ids, rows, lr_t,
     vocab, dim = param.shape
     ids = ids.astype(jnp.int32)
     rows = rows.astype(jnp.float32)
-    block = _block_size(block, ids.shape[0])
+    block = _block_size(block, ids.shape[0], dim)
     ids, rows = _pad_ids_rows(ids, rows, vocab, block)
     n = ids.shape[0]
     scal = jnp.asarray(lr_t, jnp.float32).reshape((1,))
@@ -278,9 +299,10 @@ def sparse_adam_rows(param, moment1, moment2, ids, rows, lr_t,
 
 
 def sparse_sgd_rows(param, ids, rows, lr, interpret: bool = False,
-                    block: int = _BLOCK):
+                    block=None):
     """One-kernel SGD over merged sparse rows: rows of ``param`` at ``ids``
-    get ``-lr·rows``; padded ids (== V) are dropped. Returns param."""
+    get ``-lr·rows``; padded ids (== V) are dropped. ``block=None`` =
+    tuned-table lookup (see ``_block_size``). Returns param."""
     if pltpu is None:
         raise RuntimeError(
             "sparse_sgd_rows: jax.experimental.pallas.tpu unavailable on "
@@ -289,7 +311,7 @@ def sparse_sgd_rows(param, ids, rows, lr, interpret: bool = False,
     vocab, dim = param.shape
     ids = ids.astype(jnp.int32)
     rows = rows.astype(jnp.float32)
-    block = _block_size(block, ids.shape[0])
+    block = _block_size(block, ids.shape[0], dim)
     ids, rows = _pad_ids_rows(ids, rows, vocab, block)
     n = ids.shape[0]
     scal = jnp.asarray(lr, jnp.float32).reshape((1,))

@@ -23,6 +23,7 @@ run on a clone).
 
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 import weakref
@@ -34,7 +35,7 @@ from . import analysis as A
 
 __all__ = [
     "DEFAULT_PASS_NAMES", "opt_level", "pass_enabled", "default_pipeline",
-    "optimize_program", "maybe_optimize",
+    "optimize_program", "maybe_optimize", "pass_gate_overrides",
 ]
 
 # Order matters: folding exposes CSE opportunities, both feed the pattern
@@ -86,6 +87,29 @@ def opt_level() -> int:
 def pass_enabled(name: str) -> bool:
     raw = os.environ.get("PADDLE_TPU_PASS_" + name.upper(), "1")
     return raw.strip().lower() not in ("0", "false", "no", "off")
+
+
+@contextlib.contextmanager
+def pass_gate_overrides(disabled: Iterable[str]):
+    """Temporarily force ``PADDLE_TPU_PASS_<NAME>=0`` for each name in
+    ``disabled`` (restoring prior values on exit). This is the knob the
+    autotuner's ``pass_gates`` tunable (paddle_tpu.tune) measures candidate
+    gate sets through: :func:`maybe_optimize` keys its memo on the active
+    gate set, so flipping gates here yields a freshly optimized clone
+    instead of a stale cache hit."""
+    saved = {}
+    try:
+        for name in disabled:
+            key = "PADDLE_TPU_PASS_" + str(name).upper()
+            saved[key] = os.environ.get(key)
+            os.environ[key] = "0"
+        yield
+    finally:
+        for key, prev in saved.items():
+            if prev is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prev
 
 
 def default_pipeline(scope=None, fetch_names: Optional[Iterable[str]] = None,

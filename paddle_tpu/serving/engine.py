@@ -62,7 +62,12 @@ class ServingConfig:
     size it SMALLER to oversubscribe — admission then backpressures on the
     pool instead of the slots. ``decode_fuse`` fuses that many decode steps
     into one dispatched scan (admission/retirement happen at chunk
-    boundaries — latency trades against host dispatch overhead).
+    boundaries — latency trades against host dispatch overhead);
+    ``decode_fuse="auto"`` consults the autotuned config table
+    (paddle_tpu.tune, kernel key ``serving.decode_fuse``, bucketed by slot
+    count + device kind) and falls back to 1 when no tuned entry exists —
+    ``decode_fuse_source`` records which layer answered
+    (tuned/shipped/default vs "explicit" for a literal int).
     ``continuous=False`` degrades to the padded static wave-drain baseline;
     ``paged=False`` swaps in the contiguous reference cache. ``eos_id=None``
     disables EOS stopping (generation runs to ``max_new_tokens``).
@@ -88,7 +93,7 @@ class ServingConfig:
                  max_seq: int = 128, num_pages: Optional[int] = None,
                  prompt_buckets: Optional[Sequence[int]] = None,
                  max_queue: int = 1024, eos_id: Optional[int] = None,
-                 decode_fuse: int = 1, paged: bool = True,
+                 decode_fuse=1, paged: bool = True,
                  continuous: bool = True, collect_logits: bool = False,
                  pad_id: int = 0, decode_retries: int = 2,
                  fail_fast: bool = False,
@@ -109,6 +114,9 @@ class ServingConfig:
                              % (self.prompt_buckets[-1], self.max_seq))
         self.max_queue = int(max_queue)
         self.eos_id = None if eos_id is None else int(eos_id)
+        self.decode_fuse_source = "explicit"
+        if decode_fuse is None or decode_fuse == "auto":
+            decode_fuse, self.decode_fuse_source = self._tuned_decode_fuse()
         self.decode_fuse = max(1, int(decode_fuse))
         self.paged = bool(paged)
         self.continuous = bool(continuous)
@@ -117,6 +125,16 @@ class ServingConfig:
         self.decode_retries = max(0, int(decode_retries))
         self.fail_fast = bool(fail_fast)
         self.slos = list(slos) if slos else []
+
+    def _tuned_decode_fuse(self):
+        """(value, source) from the autotuned config table; (1, "default")
+        when no entry (or any table failure — serving must come up even
+        with a corrupt table on disk). tools/serve_bench reports through
+        the SAME tune.resolve_decode_fuse, so bench and engine can't
+        diverge."""
+        from .. import tune
+
+        return tune.resolve_decode_fuse(self.slots)
 
 
 class ServingEngine:
@@ -286,6 +304,9 @@ class ServingEngine:
             "queued": self.scheduler.queue_depth,
             "running": self.scheduler.occupancy,
             "cache_bytes": self.cache_ops.cache_bytes(self._cache),
+            "decode_fuse": self.cfg.decode_fuse,
+            "decode_fuse_source": getattr(self.cfg, "decode_fuse_source",
+                                          "explicit"),
         }
         if self.pool is not None:
             out["pages_in_use"] = self.pool.num_used
@@ -605,7 +626,9 @@ class ServingEngine:
                          "pages": list(req.pages)})
         return {"layout": self.cache_ops.layout, "slots": rows,
                 "queue_depth": self.scheduler.queue_depth,
-                "decode_fuse": self.cfg.decode_fuse}
+                "decode_fuse": self.cfg.decode_fuse,
+                "decode_fuse_source": getattr(self.cfg, "decode_fuse_source",
+                                              "explicit")}
 
     # -- AOT compilation ------------------------------------------------------
     def _get_prefill_exe(self, bucket: int):

@@ -1,0 +1,220 @@
+"""Measured candidate search — the driver half of ``paddle_tpu.tune``.
+
+TVM's loop (PAPERS.md) applied to this stack's knobs: enumerate a candidate
+space, prune candidates the cost model says are obviously memory-blown,
+time the survivors on the real device (warmup first, median of k timed
+reps, compile time excluded because the first call traces+compiles before
+the clock starts), pick the winner, persist it to the config table keyed
+(kernel, shape-bucket, device_kind).
+
+The driver is deliberately backend-agnostic: on TPU it times compiled
+kernels; on CPU the same code path times Pallas interpret-mode or XLA:CPU
+executions, which is how CI exercises the whole mechanism end-to-end
+(ISSUE: the table produced from a fixed candidate list must be
+deterministic — ties break toward the earlier candidate, and tests inject
+a deterministic ``measure`` function).
+
+Pruning uses the tunable's analytic cost features (estimated VMEM working
+set per candidate — the same arithmetic the kernels' own docstrings derive)
+against a per-device budget, defaulting to 3/4 of the ~16 MB/core TPU VMEM;
+XLA ``cost_analysis`` gauges from a compiled probe can refine the budget
+but are never required (no-TPU CI must still prune the 2048x2048 tile that
+would blow VMEM on any current chip).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+from ..monitor import metrics as _mx
+from . import table as _table
+
+__all__ = ["SearchResult", "median_time_ms", "search", "vmem_budget_bytes"]
+
+_m_sweeps = _mx.counter(
+    "autotune/sweeps", help="candidate sweeps run (one per kernel x shape)")
+_m_timed = _mx.counter(
+    "autotune/candidates_timed", help="candidates actually timed on device")
+_m_pruned = _mx.counter(
+    "autotune/candidates_pruned",
+    help="candidates dropped by the analytic cost model before timing "
+         "(VMEM working set over budget)")
+_m_failed = _mx.counter(
+    "autotune/candidates_failed",
+    help="candidates whose build/measure raised (recorded, sweep continues)")
+_m_measure = _mx.histogram(
+    "autotune/measure_ms",
+    help="median candidate times observed by the search driver")
+
+# ~16 MB/core of VMEM on current TPUs (pallas_guide.md); leave headroom for
+# the compiler's own scratch. Overridable for other parts/experiments.
+_DEFAULT_VMEM_BUDGET = 12 * 2 ** 20
+
+
+def vmem_budget_bytes() -> int:
+    raw = os.environ.get("PADDLE_TPU_TUNE_VMEM_BUDGET", "").strip()
+    try:
+        return int(raw) if raw else _DEFAULT_VMEM_BUDGET
+    except ValueError:
+        return _DEFAULT_VMEM_BUDGET
+
+
+def _block(x: Any) -> None:
+    """block_until_ready over an arbitrary result pytree (numpy results —
+    e.g. an Executor fetch — are already synchronous)."""
+    import jax
+
+    try:
+        jax.block_until_ready(x)
+    except Exception:
+        pass
+
+
+def median_time_ms(fn: Callable, args: Sequence, *, warmup: int = 1,
+                   reps: int = 5, **_ignored) -> float:
+    """Median wall time of ``fn(*args)`` over ``reps`` timed calls.
+
+    The FIRST call runs before the clock starts — that is where trace +
+    compile happen, and tuned tables must rank steady-state step time, not
+    compile latency (the persistent compile cache absorbs that separately).
+    """
+    for _ in range(max(1, int(warmup))):
+        _block(fn(*args))
+    times = []
+    for _ in range(max(1, int(reps))):
+        t0 = time.perf_counter()
+        _block(fn(*args))
+        times.append((time.perf_counter() - t0) * 1e3)
+    times.sort()
+    n = len(times)
+    return times[n // 2] if n % 2 else 0.5 * (times[n // 2 - 1] + times[n // 2])
+
+
+class SearchResult:
+    """Outcome of one sweep: every candidate row (timed, pruned or failed),
+    the winner, the default config's measured time for the before/after
+    story, and where (if anywhere) the winner was persisted."""
+
+    def __init__(self, kernel: str, bucket: str, device: str, shape: dict,
+                 rows: List[dict], best: Optional[dict],
+                 best_ms: Optional[float], default_ms: Optional[float],
+                 written_path: Optional[str]):
+        self.kernel = kernel
+        self.bucket = bucket
+        self.device = device
+        self.shape = shape
+        self.rows = rows
+        self.best = best
+        self.best_ms = best_ms
+        self.default_ms = default_ms
+        self.written_path = written_path
+
+    @property
+    def speedup_vs_default(self) -> Optional[float]:
+        if self.best_ms and self.default_ms:
+            return self.default_ms / self.best_ms
+        return None
+
+    def to_dict(self) -> dict:
+        return {
+            "kernel": self.kernel, "bucket": self.bucket,
+            "device": self.device, "shape": self.shape,
+            "best": self.best, "best_ms": self.best_ms,
+            "default_ms": self.default_ms,
+            "speedup_vs_default": (round(self.speedup_vs_default, 4)
+                                   if self.speedup_vs_default else None),
+            "written": self.written_path,
+            "candidates": self.rows,
+        }
+
+
+def _same_config(a: Optional[dict], b: Optional[dict]) -> bool:
+    return a is not None and b is not None and dict(a) == dict(b)
+
+
+def search(tunable, shape: Optional[dict] = None, *,
+           candidates: Optional[Sequence[dict]] = None,
+           reps: int = 5, warmup: int = 1, persist: bool = True,
+           measure: Optional[Callable] = None,
+           budget_bytes: Optional[int] = None,
+           table_file: Optional[str] = None) -> SearchResult:
+    """Run one measured sweep for ``tunable`` at ``shape`` and (optionally)
+    persist the winner into the runtime config table.
+
+    ``candidates`` overrides the tunable's own space (fixed candidate lists
+    are how determinism is asserted); ``measure(fn, args, warmup=, reps=,
+    config=, shape=)`` overrides the timer (tests inject deterministic cost
+    functions). The default config is always appended to the space when
+    missing, so ``default_ms`` exists and the sweep can only match-or-beat
+    the hardcoded fallback.
+    """
+    shape = dict(shape if shape is not None else tunable.default_shapes()[0])
+    space = [dict(c) for c in (candidates if candidates is not None
+                               else tunable.candidates(shape))]
+    if not space:
+        raise ValueError("%s: empty candidate space for shape %r"
+                         % (tunable.kernel, shape))
+    default_cfg = dict(tunable.default_config(shape))
+    if not any(_same_config(c, default_cfg) for c in space):
+        space.append(default_cfg)
+    budget = budget_bytes if budget_bytes is not None else vmem_budget_bytes()
+    timer = measure or median_time_ms
+
+    rows: List[dict] = []
+    best = best_ms = default_ms = None
+    for cfg in space:
+        row: dict = {"config": cfg}
+        feats = {}
+        try:
+            feats = tunable.cost(shape, cfg) or {}
+        except Exception:
+            pass
+        vmem = feats.get("vmem_bytes")
+        if vmem is not None:
+            row["vmem_bytes"] = int(vmem)
+        if vmem is not None and vmem > budget:
+            row["pruned"] = "vmem %d > budget %d" % (vmem, budget)
+            if _mx._enabled:
+                _m_pruned.inc()
+            rows.append(row)
+            continue
+        try:
+            fn, args = tunable.build(shape, cfg)
+            ms = float(timer(fn, args, warmup=warmup, reps=reps,
+                             config=cfg, shape=shape))
+        except Exception as e:
+            row["error"] = "%s: %s" % (type(e).__name__, str(e)[:160])
+            if _mx._enabled:
+                _m_failed.inc()
+            rows.append(row)
+            continue
+        row["median_ms"] = round(ms, 6)
+        if _mx._enabled:
+            _m_timed.inc()
+            _m_measure.observe(ms)
+        rows.append(row)
+        if _same_config(cfg, default_cfg):
+            default_ms = ms
+        # strict < keeps ties on the EARLIER candidate — determinism of the
+        # produced table under a fixed candidate list is a tested contract
+        if best_ms is None or ms < best_ms:
+            best, best_ms = cfg, ms
+    if _mx._enabled:
+        _m_sweeps.inc()
+    if best is None:
+        raise RuntimeError(
+            "%s: no candidate survived the sweep at shape %r (all pruned "
+            "or failed): %r" % (tunable.kernel, shape, rows))
+
+    bucket = tunable.bucket(shape)
+    device = _table.device_kind()
+    written = None
+    if persist:
+        written = _table.record(
+            tunable.kernel, bucket, best, device=device, median_ms=best_ms,
+            note="autotune %s reps=%d" % (shape, reps),
+            path=table_file)
+    return SearchResult(tunable.kernel, bucket, device, shape, rows,
+                        best, best_ms, default_ms, written)

@@ -1,0 +1,409 @@
+"""paddle_tpu.tune — the autotuning subsystem (ISSUE 9).
+
+Covers the tentpole contracts: table persistence round-trip from a cold
+cache dir, same-input determinism of the search result, corrupt-table
+fallback that never crashes a training path, shipped v5e seed lookups on
+CPU, the rerouted ``_tuned_block_sizes``/``_block_size``/softmax-xent tile
+lookups, interpret-mode parity of every candidate the sweeps emit for
+flash and sparse-adam (reusing the existing parity harness style), the
+end-to-end-measured pass-gate tunable, and the serving ``decode_fuse``
+knob + serve_bench provenance reporting.
+"""
+
+import json
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import tune
+from paddle_tpu.tune import table as tt
+
+
+class _Toy(tune.Tunable):
+    """Deterministic synthetic tunable (no device timing)."""
+
+    kernel = "test.toy"
+
+    def default_shapes(self):
+        return [{"n": 32}]
+
+    def bucket(self, shape):
+        return "n%d" % shape["n"]
+
+    def candidates(self, shape):
+        return [{"x": x} for x in (1, 2, 3, 4)]
+
+    def default_config(self, shape):
+        return {"x": 1}
+
+    def cost(self, shape, config):
+        return {"vmem_bytes": 1 << 40} if config["x"] == 4 else {}
+
+    def build(self, shape, config):
+        return (lambda: config["x"]), ()
+
+
+def _toy_measure(fn, args, config=None, **kw):
+    return float(abs(config["x"] - 2) + 1)  # best at x=2
+
+
+@pytest.fixture
+def tuned_table(tmp_path, monkeypatch):
+    """Point the runtime table at a fresh per-test file."""
+    path = str(tmp_path / "autotune_table.json")
+    monkeypatch.setenv("PADDLE_TPU_TUNE_TABLE", path)
+    return path
+
+
+# -- table layer --------------------------------------------------------------
+
+
+def test_shipped_v5e_seeds(tuned_table):
+    """The checked-in shipped.json reproduces the hand-tuned v5e entries
+    as the lookup result for tpu-v5e on any backend (acceptance). The
+    tuned_table fixture points the runtime layer at an absent file so a
+    developer's own tuned table can't shadow the shipped assertion."""
+    for bucket in (tt.bucket_seq(8192, 8192), tt.bucket_seq(2048, 2048),
+                   tt.bucket_seq(1024, 1024)):  # 1024 hits the wildcard
+        cfg, src = tune.lookup("flash_attention", bucket, device="tpu-v5e")
+        assert src == "shipped", (bucket, src)
+        assert cfg == {"block_q": 512, "block_k": 512}
+    cfg, src = tune.lookup("sparse_adam", tt.bucket_rows(4096, 64),
+                           device="tpu-v5e")
+    assert src == "shipped" and cfg == {"block": 128}
+
+
+def test_default_on_unknown_device(tuned_table):
+    cfg, src = tune.lookup("flash_attention", tt.bucket_seq(8192, 8192),
+                           device="never-built-chip")
+    assert cfg is None and src == "default"
+
+
+def test_table_round_trip_cold_cache_dir(tmp_path, monkeypatch):
+    """With only PADDLE_TPU_COMPILE_CACHE set (no explicit table env), the
+    table lands next to the compile cache and survives a 'restart'
+    (fresh read through the mtime-invalidated cache)."""
+    monkeypatch.delenv("PADDLE_TPU_TUNE_TABLE", raising=False)
+    monkeypatch.setenv("PADDLE_TPU_COMPILE_CACHE", str(tmp_path / "cc"))
+    path = tune.table_path()
+    assert path == os.path.join(str(tmp_path / "cc"), "autotune_table.json")
+    assert not os.path.exists(path)  # cold
+    written = tune.record("test.kern", "s512x512", {"block_q": 256},
+                          device="cpu", median_ms=1.25)
+    assert written == path and os.path.exists(path)
+    cfg, src = tune.lookup("test.kern", "s512x512", device="cpu")
+    assert src == "tuned" and cfg == {"block_q": 256}
+    # the on-disk document is the versioned format with a complete entry
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["format"] == tt.FORMAT
+    ent = doc["entries"]["test.kern|s512x512|cpu"]
+    assert ent["config"] == {"block_q": 256} and ent["median_ms"] == 1.25
+    # record() merges — a second kernel must not clobber the first
+    tune.record("other.kern", "*", {"z": 1}, device="cpu")
+    assert tune.lookup("test.kern", "s512x512", device="cpu")[1] == "tuned"
+
+
+def test_search_determinism_fixed_candidates(tuned_table):
+    """Same fixed candidate list + deterministic measure => identical
+    result AND byte-identical table entries (acceptance)."""
+    toy = _Toy()
+    r1 = tune.search(toy, measure=_toy_measure)
+    e1 = tt.read_entries(tuned_table)
+    r2 = tune.search(toy, measure=_toy_measure)
+    e2 = tt.read_entries(tuned_table)
+    assert r1.best == r2.best == {"x": 2}
+    assert r1.best_ms == 1.0 and r1.default_ms == 2.0
+    assert e1 == e2 and "test.toy|n32|%s" % tune.device_kind() in e1
+    # the blown candidate was pruned, not timed
+    pruned = [r for r in r1.rows if "pruned" in r]
+    assert len(pruned) == 1 and pruned[0]["config"] == {"x": 4}
+
+
+def test_corrupt_table_logs_once_and_falls_back(tuned_table, caplog):
+    with open(tuned_table, "w") as f:
+        f.write('{"format": "paddle_tpu.tune/1", "entries": {broken')
+    with caplog.at_level(logging.WARNING, logger="paddle_tpu"):
+        for _ in range(3):
+            cfg, src = tune.lookup("flash_attention",
+                                   tt.bucket_seq(512, 512), device="cpu")
+            assert cfg is None and src == "default"
+    warns = [r for r in caplog.records if "corrupt" in r.getMessage()]
+    assert len(warns) == 1, "corrupt table must log exactly once"
+    # a rebuilt table clears the failure and serves again
+    tune.record("k", "b", {"v": 7}, device="cpu")
+    assert tune.lookup("k", "b", device="cpu") == ({"v": 7}, "tuned")
+
+
+def test_partially_written_table_falls_back(tuned_table):
+    """Valid JSON that is not a complete table document (the shape a torn
+    write or foreign file produces) must also degrade, not crash."""
+    for payload in ('{"entries": {"a|b|c": {"config": {}}}}',   # no format
+                    '{"format": "paddle_tpu.tune/1", "entries": '
+                    '{"a|b": {"config": {}}}}',                  # bad key
+                    '{"format": "paddle_tpu.tune/1", "entries": '
+                    '{"a|b|c": {"config": 5}}}',                 # bad config
+                    '[]'):
+        with open(tuned_table, "w") as f:
+            f.write(payload)
+        tt._file_cache.pop(tuned_table, None)  # force re-parse
+        cfg, src = tune.lookup("a", "b", device="c")
+        assert cfg is None and src == "default", payload
+
+
+def test_provenance_snapshot(tuned_table):
+    tune.reset_provenance()
+    tune.record("flash_attention", "s512x512", {"block_q": 256,
+                                                "block_k": 128})
+    tune.lookup("flash_attention", "s512x512")
+    prov = tune.provenance_snapshot()
+    assert prov["flash_attention"]["source"] == "tuned"
+    assert prov["flash_attention"]["config"]["block_q"] == 256
+
+
+# -- rerouted lookups ---------------------------------------------------------
+
+
+def test_tuned_block_sizes_reroute(tuned_table):
+    """_tuned_block_sizes consults the table first; tuned tiles clamp to
+    the shape's divisors; no table => the hardcoded v5e fallback. The
+    sweep's own make_block_sizes must agree with the serving-side mapping
+    (one shared _block_sizes_for definition)."""
+    from paddle_tpu.ops import attention_ops as ao
+
+    tun = tune.get_tunable("flash_attention")
+    assert tun.make_block_sizes({"block_q": 256, "block_k": 128},
+                                512, 512) == ao._block_sizes_for(256, 128)
+
+    # pure fallback (cold table): unchanged hand-tuned behavior
+    bs = ao._tuned_block_sizes(8192, 8192)
+    assert bs.block_q == 512 and bs.block_k == 512
+    # tuned entry wins...
+    tune.record("flash_attention", tt.bucket_seq(512, 512),
+                {"block_q": 256, "block_k": 128})
+    bs = ao._tuned_block_sizes(512, 512)
+    assert bs.block_q == 256 and bs.block_k == 128
+    assert bs.block_q_dkv == 256 and bs.block_k_major_dq == 128
+    # ...and a tuned 512 serving a non-multiple length clamps to a divisor
+    tune.record("flash_attention", tt.bucket_seq(384, 384),
+                {"block_q": 512, "block_k": 512})
+    bs = ao._tuned_block_sizes(384, 384)
+    assert bs.block_q == 128 and bs.block_k == 128  # 384 = 3*128
+
+
+def test_sparse_block_size_reroute(tuned_table):
+    from paddle_tpu.ops.pallas_kernels.sparse_adam import _BLOCK, _block_size
+
+    # pure fallback: the hardcoded default, rounded/shrunk as before
+    assert _block_size(None, 1024, 16) == _BLOCK
+    assert _block_size(None, 20, 16) == 24  # shrunk + rounded to 8
+    assert _block_size(64, 1024, 16) == 64  # explicit int honored verbatim
+    tune.record("sparse_adam", tt.bucket_rows(1024, 16), {"block": 32})
+    assert _block_size(None, 1024, 16) == 32
+    # explicit block still bypasses the table (the sweep's own calls)
+    assert _block_size(64, 1024, 16) == 64
+
+
+def test_softmax_xent_tile_reroute(tuned_table):
+    from paddle_tpu.ops.pallas_kernels import softmax_xent as sx
+
+    assert sx._tile_sizes(4096, 32768) == (sx._BN, sx._BV)  # fallback
+    tune.record("softmax_xent", tt.bucket_nv(4096, 32768),
+                {"block_n": 64, "block_v": 1024})
+    assert sx._tile_sizes(4096, 32768) == (64, 1024)
+    # insane tuned values sanitize to legal sublane/lane multiples
+    tune.record("softmax_xent", tt.bucket_nv(4096, 32768),
+                {"block_n": 3, "block_v": 100})
+    assert sx._tile_sizes(4096, 32768) == (8, 128)
+
+
+# -- candidate parity (interpret mode, real kernel bodies) --------------------
+
+
+def _composed_attention(q, k, v, causal, sm_scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm_scale
+    if causal:
+        mask = jnp.tril(jnp.ones(s.shape[-2:], bool))
+        s = jnp.where(mask, s, -1e30)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+
+
+def test_flash_candidates_parity(rng):
+    """EVERY candidate the flash sweep emits at its CPU shape must run the
+    real kernel body (interpret mode) and match composed attention — a
+    tuned config may only change speed, never numerics."""
+    tun = tune.get_tunable("flash_attention")
+    shape = tun.default_shapes()[0]
+    cands = tun.candidates(shape)
+    assert len(cands) >= 4
+    from paddle_tpu.ops.pallas_kernels import flash_attention as fa
+
+    b, h, s, d = shape["b"], shape["h"], shape["s"], shape["d"]
+    q, k, v = (jnp.asarray(rng.randn(b, h, s, d).astype("float32"))
+               for _ in range(3))
+    sm = 1.0 / d ** 0.5
+    ref = _composed_attention(q, k, v, shape["causal"], sm)
+    prev = fa.INTERPRET
+    fa.INTERPRET = True
+    try:
+        for cfg in cands:
+            bs = tun.make_block_sizes(cfg, s, s)
+            out = fa.flash_attention(q, k, v, causal=shape["causal"],
+                                     sm_scale=sm, block_sizes=bs)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5,
+                err_msg="flash candidate %r diverged" % (cfg,))
+    finally:
+        fa.INTERPRET = prev
+
+
+def test_sparse_adam_candidates_parity(rng):
+    """EVERY candidate block size the sparse sweep emits must match the
+    XLA scatter formulation (the test_sparse_kernel harness math) on
+    duplicate-bearing ids."""
+    from paddle_tpu.core.sparse import merge_rows
+    from paddle_tpu.ops.pallas_kernels.sparse_adam import sparse_adam_rows
+
+    tun = tune.get_tunable("sparse_adam")
+    shape = tun.default_shapes()[0]
+    vocab, dim, n = shape["vocab"], shape["dim"], shape["n"]
+    ids = rng.randint(0, vocab, (n,)).astype(np.int32)
+    ids[: n // 4] = ids[n // 4: n // 2]  # duplicates
+    uniq, merged = merge_rows(jnp.asarray(ids),
+                              jnp.asarray(rng.randn(n, dim).astype("float32")),
+                              vocab)
+    p = jnp.asarray(rng.randn(vocab, dim).astype("float32"))
+    m = jnp.asarray(rng.randn(vocab, dim).astype("float32") * 0.1)
+    v = jnp.asarray(np.abs(rng.randn(vocab, dim)).astype("float32"))
+    b1, b2, eps, lr_t = 0.9, 0.999, 1e-8, 0.01
+    m_rows = b1 * m[uniq] + (1 - b1) * merged
+    v_rows = b2 * v[uniq] + (1 - b2) * jnp.square(merged)
+    ref_p = p.at[uniq].add(-(lr_t * m_rows / (jnp.sqrt(v_rows) + eps)))
+    cands = tun.candidates(shape)
+    assert len(cands) >= 4
+    for cfg in cands:
+        k_p, k_m, k_v = sparse_adam_rows(p, m, v, uniq, merged, lr_t,
+                                         b1, b2, eps, interpret=True,
+                                         block=int(cfg["block"]))
+        np.testing.assert_allclose(
+            np.asarray(k_p), np.asarray(ref_p), rtol=1e-6, atol=1e-6,
+            err_msg="sparse-adam candidate %r diverged" % (cfg,))
+
+
+def test_softmax_xent_candidates_parity(rng):
+    """Every (block_n, block_v) tile candidate computes the same loss as
+    the XLA log_softmax reference."""
+    from paddle_tpu.ops.pallas_kernels import softmax_xent as sx
+
+    tun = tune.get_tunable("softmax_xent")
+    shape = dict(n=32, v=512)  # smaller than the sweep point: fast + odd
+    logits = jnp.asarray(rng.randn(shape["n"], shape["v"]).astype("float32"))
+    labels = jnp.asarray(
+        rng.randint(0, shape["v"], (shape["n"], 1)).astype(np.int32))
+    ref = -jnp.take_along_axis(jax.nn.log_softmax(logits, axis=-1),
+                               labels, axis=1)
+    for cfg in tun.candidates(shape):
+        bn, bv = sx._shrink_tiles(shape["n"], shape["v"],
+                                  cfg["block_n"], cfg["block_v"])
+        plog, plab, n_pad, v_pad = sx._pad_to(logits, labels, bn, bv)
+        loss, lse = sx._call_fwd(plog, plab, bn, bv, True, 0.0, shape["v"])
+        np.testing.assert_allclose(
+            np.asarray(loss[:shape["n"]]), np.asarray(ref),
+            rtol=2e-5, atol=2e-5,
+            err_msg="softmax-xent tile %r diverged" % (cfg,))
+
+
+# -- search driver ------------------------------------------------------------
+
+
+def test_search_real_sparse_sweep_picks_within_noise(tuned_table):
+    """A real (interpret-mode) micro-sweep must persist a winner whose
+    measured time is the minimum of its candidate rows — 'within noise of
+    the best candidate in its space' is exact here because the winner IS
+    the measured min (acceptance)."""
+    tun = tune.get_tunable("sparse_adam")
+    shape = dict(vocab=64, dim=8, n=24)
+    res = tune.search(tun, shape, candidates=[{"block": 8}, {"block": 16}],
+                      reps=1, warmup=1)
+    timed = [r for r in res.rows if "median_ms" in r]
+    assert round(res.best_ms, 6) == min(r["median_ms"] for r in timed)
+    assert res.written_path == tuned_table
+    cfg, src = tune.lookup("sparse_adam", res.bucket)
+    assert src == "tuned" and cfg == res.best
+
+
+def test_search_failed_candidate_recorded_not_fatal(tuned_table):
+    class _Flaky(_Toy):
+        def build(self, shape, config):
+            if config["x"] == 1:
+                raise RuntimeError("boom")
+            return super().build(shape, config)
+
+    res = tune.search(_Flaky(), measure=_toy_measure)
+    errs = [r for r in res.rows if "error" in r]
+    assert len(errs) == 1 and "boom" in errs[0]["error"]
+    assert res.best == {"x": 2} and res.default_ms is None
+
+
+def test_pass_gates_tunable_end_to_end(tuned_table):
+    """The pass-gate tunable measures REAL end-to-end step time on the
+    optimized clone per gate set and persists a winner keyed on the
+    program fingerprint."""
+    from paddle_tpu.passes.pipeline import DEFAULT_PASS_NAMES
+
+    tun = tune.get_tunable("pass_gates")
+    try:
+        shape = tun.default_shapes()[0]
+        cands = tun.candidates(shape)
+        assert cands[0] == {"disable": []}
+        assert len(cands) == 1 + len(DEFAULT_PASS_NAMES)
+        # 3 candidates keeps the test fast; each compiles its own clone
+        res = tune.search(tun, shape, candidates=cands[:3], reps=2,
+                          warmup=1)
+        assert res.bucket.startswith("prog")
+        assert all("median_ms" in r for r in res.rows)
+        assert res.best in cands[:3]
+        cfg, src = tune.lookup("pass_gates", res.bucket)
+        assert src == "tuned" and cfg == res.best
+    finally:
+        tun.cleanup()
+
+
+# -- serving knob -------------------------------------------------------------
+
+
+def test_decode_fuse_auto_consults_table(tuned_table):
+    from paddle_tpu import serving
+
+    cfg = serving.ServingConfig(slots=4, page_size=8, max_seq=64,
+                                decode_fuse="auto")
+    assert cfg.decode_fuse == 1 and cfg.decode_fuse_source == "default"
+    tune.record("serving.decode_fuse", tt.bucket_slots(4), {"decode_fuse": 2})
+    cfg = serving.ServingConfig(slots=4, page_size=8, max_seq=64,
+                                decode_fuse="auto")
+    assert cfg.decode_fuse == 2 and cfg.decode_fuse_source == "tuned"
+    # explicit ints keep bypassing the table
+    cfg = serving.ServingConfig(slots=4, page_size=8, max_seq=64,
+                                decode_fuse=3)
+    assert cfg.decode_fuse == 3 and cfg.decode_fuse_source == "explicit"
+
+
+def test_serve_bench_reports_decode_fuse_source(tuned_table):
+    from tools.serve_bench import resolve_decode_fuse
+
+    assert resolve_decode_fuse(2, 8) == (2, "explicit")
+    assert resolve_decode_fuse(None, 8) == (1, "default")
+    tune.record("serving.decode_fuse", tt.bucket_slots(8), {"decode_fuse": 4})
+    assert resolve_decode_fuse(None, 8) == (4, "tuned")
+
+
+def test_decode_fuse_tunable_space():
+    tun = tune.get_tunable("serving.decode_fuse")
+    shape = tun.default_shapes()[0]
+    assert tun.default_config(shape) == {"decode_fuse": 1}
+    assert {c["decode_fuse"] for c in tun.candidates(shape)} == {1, 2, 4}
+    assert tun.bucket(shape) == "slots4"

@@ -376,6 +376,43 @@ def selftest() -> int:
     assert "selftest_lat_ms_count 5" in prom
     assert "selftest_lat_ms_sum" in prom
     metrics.reset()
+
+    # 8. autotune/* counters + the tuned-config lookup ladder (the sweep
+    #    mechanism has its own gate, tools/autotune --selftest). Point the
+    #    runtime table at a guaranteed-absent file so a developer's own
+    #    tuned table can't change what this CI assertion sees.
+    from paddle_tpu import tune
+
+    prev_tbl = os.environ.get("PADDLE_TPU_TUNE_TABLE")
+    with tempfile.TemporaryDirectory() as td:
+        os.environ["PADDLE_TPU_TUNE_TABLE"] = os.path.join(td, "none.json")
+        try:
+            cfg, src = tune.lookup("flash_attention",
+                                   tune.bucket_seq(8192, 8192),
+                                   device="tpu-v5e")
+            assert src == "shipped" and cfg["block_q"] == 512, (cfg, src)
+            cfg, src = tune.lookup("sparse_adam", tune.bucket_rows(1024, 64),
+                                   device="tpu-v5e")
+            assert src == "shipped" and cfg["block"] == 128, (cfg, src)
+            cfg, src = tune.lookup("flash_attention",
+                                   tune.bucket_seq(128, 128),
+                                   device="made-up-chip")
+            assert cfg is None and src == "default"
+        finally:
+            if prev_tbl is None:
+                os.environ.pop("PADDLE_TPU_TUNE_TABLE", None)
+            else:
+                os.environ["PADDLE_TPU_TUNE_TABLE"] = prev_tbl
+    snap = metrics.snapshot()
+    assert snap["autotune/lookups"]["value"] >= 3
+    assert snap["autotune/lookup_shipped"]["value"] >= 2
+    assert snap["autotune/lookup_default"]["value"] >= 1
+    for name in ("autotune/sweeps", "autotune/candidates_timed",
+                 "autotune/candidates_pruned", "autotune/candidates_failed",
+                 "autotune/table_writes", "autotune/table_errors",
+                 "autotune/measure_ms"):
+        assert name in snap, "missing instrument %s" % name
+    metrics.reset()
     print("dump_metrics selftest: OK")
     return 0
 

@@ -83,16 +83,30 @@ def drive(model, stream, scfg, warmup=True, keep_open=False):
     }, eng
 
 
+def resolve_decode_fuse(decode_fuse, slots):
+    """(value, source) for the bench's ``decode_fuse``: an explicit int is
+    honored verbatim; ``None`` resolves through the SAME
+    ``tune.resolve_decode_fuse`` helper ``ServingConfig(decode_fuse=
+    "auto")`` uses, so the bench reports exactly what the engine runs."""
+    if decode_fuse is not None:
+        return int(decode_fuse), "explicit"
+    from paddle_tpu import tune
+
+    return tune.resolve_decode_fuse(slots)
+
+
 def serve_bench(n_requests=64, slots=8, vocab=512, n_layer=4, d_model=128,
                 n_head=4, max_seq=256, page_size=16, max_prompt=128,
-                max_new_hi=64, decode_fuse=1, seed=0):
+                max_new_hi=64, decode_fuse=None, seed=0):
     """Ragged continuous batching vs the padded static-batch baseline on
     the SAME synthetic mixed-length stream. Returns the comparison dict
     ``bench.py --serve`` embeds (and summarizes in its truncation-proof
-    tail)."""
+    tail). ``decode_fuse=None`` = consult the autotuned table (the config
+    block reports the value AND which layer supplied it)."""
     from paddle_tpu import serving
     from paddle_tpu.models import decoder_lm
 
+    decode_fuse, fuse_src = resolve_decode_fuse(decode_fuse, slots)
     cfg = decoder_lm.DecoderConfig(vocab_size=vocab, n_layer=n_layer,
                                    d_model=d_model, n_head=n_head,
                                    max_seq=max_seq)
@@ -110,7 +124,8 @@ def serve_bench(n_requests=64, slots=8, vocab=512, n_layer=4, d_model=128,
                    "n_layer": n_layer, "d_model": d_model, "n_head": n_head,
                    "max_seq": max_seq, "page_size": page_size,
                    "max_prompt": max_prompt, "max_new_hi": max_new_hi,
-                   "decode_fuse": decode_fuse, "seed": seed,
+                   "decode_fuse": decode_fuse,
+                   "decode_fuse_source": fuse_src, "seed": seed,
                    "backend": _backend()},
         "continuous_paged": ragged,
         "static_padded": padded,
@@ -213,6 +228,13 @@ def selftest() -> int:
     assert snap["serving/tokens_generated"]["value"] >= sum(
         r.max_new_tokens for r in reqs)
     assert snap["serving/request_latency_ms"]["count"] >= 6
+    # the tuned decode_fuse hookup: the bench reports which table layer
+    # supplied the value (plain "default" in CI — no tuned table present,
+    # but a tuned entry written by tools/autotune.py flows through here)
+    fuse_val, fuse_src = resolve_decode_fuse(None, 4)
+    assert fuse_val >= 1 and fuse_src in ("tuned", "shipped", "default"), (
+        fuse_val, fuse_src)
+    assert eng.stats()["decode_fuse_source"] == "explicit"
     # backpressure: the bounded queue rejects with the typed error (submit
     # never compiles, so this costs nothing)
     eng2 = serving.ServingEngine(model, serving.ServingConfig(
