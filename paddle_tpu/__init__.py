@@ -44,6 +44,7 @@ from . import (  # noqa: F401
     backward,
     clip,
     contrib,
+    data,
     dataset,
     debugger,
     imperative,

@@ -1,0 +1,45 @@
+"""paddle_tpu.data — exactly-once, corruption-tolerant ingestion.
+
+The host-side half of self-healing training (ROADMAP item 5's streaming
+ingestion, re-grounded in the reference's AsyncExecutor MultiSlot readers):
+
+* :class:`~.reader.CheckpointableReader` — deterministic batches over
+  sharded line-record files whose FULL position (epoch/shard/record,
+  counters, quarantined ids) is a JSON ``state_dict``. ``run_supervised``
+  persists it in every rotating checkpoint and restores it on resume:
+  exactly-once consumption across kill/resume with zero caller-side
+  bookkeeping (the legacy ``feed_source(start_step)`` callable contract
+  still works).
+* Corrupt records (typed parse/shape/dtype validation) are skipped and
+  appended to a quarantine JSONL with id + reason; a corrupt rate above
+  the bound raises :class:`~.reader.DataCorruptionError` instead of
+  silently starving training. The divergence sentinel
+  (:mod:`paddle_tpu.reliability.sentinel`) quarantines whole data windows
+  through the same :meth:`~.reader.CheckpointableReader.quarantine`.
+* :class:`~.multislot.MultiSlotTextReader` /
+  :class:`~.multislot.CTRMultiSlotReader` — the AsyncExecutor MultiSlot
+  text format, streamed checkpointably into the DeepFM/CTR bench feed.
+* :meth:`~.reader.CheckpointableReader.prefetch` — bounded parse-ahead
+  that keeps the checkpoint contract and composes with
+  :class:`~paddle_tpu.reader.DevicePrefetcher` for the H2D overlap.
+
+Counters: ``data/*`` (:mod:`~.metrics`), exported continuously by the
+telemetry layer like every other registry family.
+"""
+
+from . import metrics  # noqa: F401  (registers the data/* instruments)
+from .multislot import (  # noqa: F401
+    CTRMultiSlotReader, MultiSlotTextReader, ctr_slots, slot,
+    write_ctr_shards,
+)
+from .reader import (  # noqa: F401
+    CheckpointableReader, DataCorruptionError, FieldSpec, PrefetchReader,
+    RecordError,
+)
+
+__all__ = [
+    "CheckpointableReader", "PrefetchReader", "FieldSpec",
+    "RecordError", "DataCorruptionError",
+    "MultiSlotTextReader", "CTRMultiSlotReader", "ctr_slots", "slot",
+    "write_ctr_shards", "metrics",
+]

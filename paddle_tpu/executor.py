@@ -1259,6 +1259,18 @@ class Executor:
             feeds = {k: jax.device_put(v, plan.batch_sh)
                      for k, v in feeds.items()}
         else:
+            if state:
+                # State rides a donate_argnums=(0,) jit. Host (numpy)
+                # entries — the scope right after a checkpoint load — MUST
+                # become jax-OWNED copies first: on the CPU backend a
+                # zero-copy device_put would alias the numpy buffer, and
+                # donating an aliased buffer lets the async execution keep
+                # using memory Python frees the moment the scope swaps in
+                # the step's outputs (observed as rare corrupted/NaN state
+                # in the first chunk after a restore). jax.Arrays pass
+                # through untouched — the steady-state carry costs nothing.
+                state = {k: v if isinstance(v, jax.Array) else jnp.array(v)
+                         for k, v in state.items()}
             dev = self._device()
             if dev is not None and feeds:
                 # jax.Arrays already on the right device skip the device_put —
@@ -1394,6 +1406,7 @@ class Executor:
                             # typed data-side error: names the step index
                             # within the chunk (and the global step), and
                             # rides the outer except into the flight dump
+                            _faults.record_feed_error()
                             raise FeedError(
                                 "run_steps(): feed source raised at global "
                                 "step %d (position %d of the current "
@@ -1403,6 +1416,7 @@ class Executor:
                     try:
                         sig, f = _shape_sig(f)
                     except Exception as e:
+                        _faults.record_feed_error()
                         raise FeedError(
                             "run_steps(): feed for global step %d (position "
                             "%d of the current %d-step chunk) could not be "

@@ -70,7 +70,12 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None, f
         val = scope.find_var(name)
         if val is None:
             raise RuntimeError("save_vars: %r not found in scope (run startup first)" % name)
-        arrays[name] = np.asarray(val)
+        # copy=True, not a view: np.asarray of a CPU-backend jax array can
+        # be ZERO-COPY, and the very next fused chunk DONATES these state
+        # buffers — a view captured here would then alias memory XLA is
+        # about to scribble outputs into (observed as rare non-determinism
+        # in the rollback drill's post-checkpoint chunk)
+        arrays[name] = np.array(val, copy=True)
     if filename is None:
         for name, arr in arrays.items():
             np.save(os.path.join(dirname, name.replace("/", "__") + ".npy"), arr)

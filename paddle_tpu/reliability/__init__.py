@@ -15,10 +15,19 @@ this package makes the system *survive* them —
 * :mod:`~.supervisor`: :func:`~.supervisor.run_supervised` — the
   preemption-aware training driver: SIGTERM/SIGINT finish the in-flight
   fused chunk, write a rotating checkpoint and exit with
-  :data:`~.supervisor.EXIT_PREEMPTED`; periodic auto-checkpoint;
-  auto-resume with the per-step RNG counter rewound so the resumed loss
-  trajectory is bit-identical to an uninterrupted run; bounded
-  retry-with-backoff for transient faults.
+  :data:`~.supervisor.EXIT_PREEMPTED`; periodic auto-checkpoint (a
+  checkpointable feed source's position — ``paddle_tpu.data`` — rides
+  inside every serial); auto-resume with the per-step RNG counter AND
+  the data-reader position rewound so the resumed trajectory is
+  bit-identical and exactly-once; bounded retry with seeded-jitter
+  backoff (:func:`~.supervisor.backoff_schedule`) for transient faults.
+* :mod:`~.sentinel`: :class:`~.sentinel.DivergenceSentinel` — declarative
+  divergence rules (NaN/watchdog, loss-spike z-score, plateau, grad-norm
+  ceiling) evaluated per fused chunk; a trip rolls back to the last good
+  checkpoint (model + RNG + reader state), quarantines the offending
+  data window through the reader, optionally backs off LR, and resumes —
+  bounded by ``max_trips`` with repeat-trip-at-same-step fatal
+  (:class:`~.sentinel.SentinelFatal` carrying the watchdog-named op).
 
 Serving-side recovery (per-request deadlines, decode-failure batch
 recovery, ``engine.health()``) lives in :mod:`paddle_tpu.serving` and uses
@@ -28,18 +37,25 @@ multi-process kill/resume drill in ``tests/test_dist_multiprocess.py``.
 """
 
 from . import faults  # noqa: F401
+from . import sentinel  # noqa: F401
 from .faults import (  # noqa: F401
     FaultPlan, FaultSpec, InjectedFault, TransientFault,
     InjectedResourceExhausted, PreemptionRequested, classify,
 )
+from .sentinel import (  # noqa: F401
+    DivergenceSentinel, SentinelFatal, SentinelTrip,
+)
 
 __all__ = [
-    "faults", "FaultPlan", "FaultSpec", "InjectedFault", "TransientFault",
-    "InjectedResourceExhausted", "PreemptionRequested", "classify",
+    "faults", "sentinel", "FaultPlan", "FaultSpec", "InjectedFault",
+    "TransientFault", "InjectedResourceExhausted", "PreemptionRequested",
+    "classify", "DivergenceSentinel", "SentinelFatal", "SentinelTrip",
     "EXIT_PREEMPTED", "SupervisorResult", "run_supervised",
+    "backoff_schedule",
 ]
 
-_SUPERVISOR_NAMES = ("EXIT_PREEMPTED", "SupervisorResult", "run_supervised")
+_SUPERVISOR_NAMES = ("EXIT_PREEMPTED", "SupervisorResult", "run_supervised",
+                     "backoff_schedule")
 
 
 def __getattr__(name):

@@ -74,6 +74,17 @@ KINDS = ("preempt", "transient", "resource", "fatal", "nan", "latency",
 _m_injected = _mx.counter(
     "reliability/faults_injected",
     help="faults fired by the active FaultPlan, all sites")
+_m_feed_errors = _mx.counter(
+    "reliability/feed_errors",
+    help="typed executor.FeedError raises (feed source failed mid-chunk) — "
+         "the data-side failure signal SLOs and dashboards watch")
+
+
+def record_feed_error() -> None:
+    """Tick ``reliability/feed_errors`` (called by the executor's typed
+    FeedError paths, so data-pipeline failures are visible to telemetry,
+    not just the flight recorder)."""
+    _m_feed_errors.inc()
 
 
 class InjectedFault(RuntimeError):

@@ -1,0 +1,278 @@
+"""Divergence sentinel: declarative rules + checkpoint rollback = self-heal.
+
+PR 7 made crashes survivable and the CHECK_NUMERICS=2 watchdog *names* the
+op a NaN was born at — but the job still dies. The sentinel closes the
+loop (the Tensor Processing Primitives thesis — attribution should drive
+automated *recovery*, not just diagnosis): :func:`~.supervisor
+.run_supervised` evaluates a :class:`DivergenceSentinel` against every
+fused chunk's fetched losses (and against the watchdog's typed exception
+when the guarded step trips first); a rule firing **rolls the run back**
+to the last good rotating checkpoint — model + optimizer state + per-step
+RNG counter + data-reader position, all three restored together —
+**quarantines** the data window that preceded the trip (reader-mode feed
+sources only; the records are skipped on replay and on every later epoch),
+optionally backs off the LR, and resumes. The healed trajectory is
+bit-identical to a run that never saw the poisoned batches (the chaos
+drill asserts this in hex).
+
+Rules (all declarative constructor knobs):
+
+``nan``             non-finite loss in the chunk, or the numerics
+                    watchdog's typed exception (level 1 or 2; the level-2
+                    ``<slot>:<type>`` op name is carried into the trip
+                    record, the flight dump and the fatal error).
+``spike_z``         windowed loss-spike z-score: trip when a chunk loss
+                    deviates from the trailing ``spike_window`` committed
+                    losses by more than ``spike_z`` standard deviations.
+``plateau_window``  no improvement of at least ``plateau_min_delta`` over
+                    the last ``plateau_window`` committed losses (pair it
+                    with ``lr_backoff``; a plateau rollback alone replays
+                    the same plateau).
+``max_grad_norm``   ceiling on the ``optimizer/grad_global_norm`` gauge
+                    (requires ``PADDLE_TPU_GRAD_NORM=1``).
+
+The rollback budget is bounded: ``max_trips`` total, and a SECOND trip at
+the same chunk-start step is immediately fatal (the quarantine did not
+help — the divergence is systematic, not data). Fatal = flight-recorder
+``sentinel_fatal`` event + typed :class:`SentinelFatal` carrying the trip
+history and the watchdog-named op. ``sentinel/*`` counters ride the
+telemetry exporter like every other family.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..monitor import metrics as _mx
+
+__all__ = ["DivergenceSentinel", "SentinelTrip", "SentinelFatal"]
+
+_m_trips = _mx.counter("sentinel/trips",
+                       help="divergence rules tripped (all rules)")
+_m_rollbacks = _mx.counter(
+    "sentinel/rollbacks",
+    help="checkpoint rollbacks performed by the supervisor on a trip")
+_m_quarantined = _mx.counter(
+    "sentinel/records_quarantined",
+    help="records quarantined as part of a tripped data window")
+_m_lr_backoffs = _mx.counter(
+    "sentinel/lr_backoffs", help="LR backoffs applied on a trip")
+_m_fatals = _mx.counter(
+    "sentinel/fatals",
+    help="trips escalated to SentinelFatal (budget exhausted or repeat "
+         "trip at the same step)")
+_m_rule = {r: _mx.counter("sentinel/trips_%s" % r,
+                          help="trips attributed to the %s rule" % r)
+           for r in ("nan", "spike", "plateau", "grad_norm")}
+
+_WATCHDOG_OP_RE = re.compile(r"first produced by op (\S+)")
+
+
+class SentinelTrip:
+    """One rule firing: where, why, and (for watchdog trips) which op."""
+
+    __slots__ = ("step", "rule", "reason", "named_op", "chunk_steps")
+
+    def __init__(self, step: int, rule: str, reason: str,
+                 named_op: Optional[str] = None, chunk_steps: int = 1):
+        self.step = int(step)
+        self.rule = rule
+        self.reason = reason
+        self.named_op = named_op
+        self.chunk_steps = int(chunk_steps)
+
+    def to_doc(self) -> dict:
+        return {"step": self.step, "rule": self.rule, "reason": self.reason,
+                "named_op": self.named_op, "chunk_steps": self.chunk_steps}
+
+    def __repr__(self):
+        return "SentinelTrip(step=%d, rule=%s, op=%s: %s)" % (
+            self.step, self.rule, self.named_op, self.reason)
+
+
+class SentinelFatal(RuntimeError):
+    """The sentinel gave up: rollback budget exhausted or a repeat trip at
+    the same step. Carries the full trip history; the watchdog-named op of
+    the final trip rides in the message and in ``.trips[-1].named_op``."""
+
+    def __init__(self, msg: str, trips: Sequence[SentinelTrip]):
+        super().__init__(msg)
+        self.trips = list(trips)
+
+
+class DivergenceSentinel:
+    """Rule set + trip bookkeeping. One instance supervises one
+    ``run_supervised`` call (trip history is per-run state)."""
+
+    def __init__(self, *,
+                 nan: bool = True,
+                 spike_z: Optional[float] = None,
+                 spike_window: int = 32,
+                 spike_min_history: int = 8,
+                 plateau_window: Optional[int] = None,
+                 plateau_min_delta: float = 0.0,
+                 max_grad_norm: Optional[float] = None,
+                 loss_index: int = 0,
+                 max_trips: int = 3,
+                 lr_backoff: Optional[float] = None,
+                 lr_var: Optional[str] = None):
+        if spike_z is not None and spike_z <= 0:
+            raise ValueError("spike_z must be positive")
+        if lr_backoff is not None and not (0 < lr_backoff < 1):
+            raise ValueError("lr_backoff must be a factor in (0, 1)")
+        if lr_backoff is not None and not lr_var:
+            raise ValueError("lr_backoff needs lr_var (the scope name of "
+                             "the learning-rate variable to scale)")
+        self.nan = bool(nan)
+        self.spike_z = spike_z
+        self.spike_window = int(spike_window)
+        self.spike_min_history = int(spike_min_history)
+        self.plateau_window = plateau_window
+        self.plateau_min_delta = float(plateau_min_delta)
+        self.max_grad_norm = max_grad_norm
+        self.loss_index = int(loss_index)
+        self.max_trips = int(max_trips)
+        self.lr_backoff = lr_backoff
+        self.lr_var = lr_var
+        self.trips: List[SentinelTrip] = []
+        self._trip_steps = {}  # chunk-start step -> trip count
+
+    # -- rule evaluation ------------------------------------------------------
+    def _loss(self, row) -> float:
+        return float(np.asarray(row[self.loss_index]).ravel()[0])
+
+    def history_window(self) -> int:
+        """How many trailing committed losses the rules actually read —
+        the supervisor slices its loss list to this, so a long run's
+        per-chunk evaluation stays O(window), not O(steps so far)."""
+        need = max(self.spike_window, self.spike_min_history, 1)
+        if self.plateau_window is not None:
+            need = max(need, 2 * int(self.plateau_window))
+        return need
+
+    def check_exception(self, exc: BaseException) -> Optional[SentinelTrip]:
+        """Map a chunk-dispatch exception to a trip: the numerics
+        watchdog's typed errors (level 2 names the originating op; level 1
+        is the fetch/state-level backstop) are divergence, everything else
+        is the retry ladder's business."""
+        if not self.nan:
+            return None
+        txt = str(exc)
+        from ..core.enforce import EnforceNotMet
+
+        if isinstance(exc, EnforceNotMet) and "CHECK_NUMERICS" in txt:
+            m = _WATCHDOG_OP_RE.search(txt)
+            return SentinelTrip(
+                -1, "nan", txt.splitlines()[0],
+                named_op=m.group(1) if m else None)
+        if isinstance(exc, RuntimeError) and "check_nan_inf" in txt:
+            return SentinelTrip(-1, "nan", txt.splitlines()[0])
+        return None
+
+    def check_rows(self, rows: Sequence,
+                   history: Sequence[float]) -> Optional[SentinelTrip]:
+        """Evaluate the rules against one committed-candidate chunk.
+        ``rows``: per-step fetch rows of the chunk; ``history``: committed
+        per-step losses BEFORE this chunk (the supervisor's loss list, so
+        a rollback rewinds the window for free)."""
+        losses = [self._loss(r) for r in rows]
+        if self.nan:
+            for i, v in enumerate(losses):
+                if not np.isfinite(v):
+                    return SentinelTrip(
+                        i, "nan", "non-finite loss %r at step %d of the "
+                        "chunk" % (v, i), chunk_steps=len(rows))
+        if self.spike_z is not None and \
+                len(history) >= self.spike_min_history:
+            win = np.asarray(history[-self.spike_window:], np.float64)
+            mean = float(win.mean())
+            std = float(win.std())
+            floor = max(1e-12, 1e-6 * abs(mean))
+            std = max(std, floor)
+            for i, v in enumerate(losses):
+                z = abs(v - mean) / std
+                if z > self.spike_z:
+                    return SentinelTrip(
+                        i, "spike",
+                        "loss %.6g is %.1f sigma from the trailing-%d "
+                        "window mean %.6g" % (v, z, len(win), mean),
+                        chunk_steps=len(rows))
+        if self.plateau_window is not None:
+            w = int(self.plateau_window)
+            full = list(history) + losses
+            if len(full) >= 2 * w:
+                recent = min(full[-w:])
+                before = min(full[-2 * w:-w])
+                if recent >= before - self.plateau_min_delta:
+                    return SentinelTrip(
+                        0, "plateau",
+                        "best loss %.6g over the last %d steps did not "
+                        "improve on %.6g by %g" % (recent, w, before,
+                                                   self.plateau_min_delta),
+                        chunk_steps=len(rows))
+        if self.max_grad_norm is not None:
+            # get-or-create returns the same instance the executor feeds
+            # (PADDLE_TPU_GRAD_NORM=1); never-written stays silent
+            g = _mx.gauge("optimizer/grad_global_norm")
+            if getattr(g, "_written", False):
+                gn = float(g.value)
+                if not np.isfinite(gn) or gn > self.max_grad_norm:
+                    return SentinelTrip(
+                        0, "grad_norm",
+                        "grad global norm %.6g exceeds ceiling %.6g"
+                        % (gn, self.max_grad_norm), chunk_steps=len(rows))
+        return None
+
+    # -- trip bookkeeping (called by the supervisor) --------------------------
+    def register_trip(self, chunk_start: int, trip: SentinelTrip) -> None:
+        """Record a trip at ``chunk_start``; raises :class:`SentinelFatal`
+        when the budget is exhausted or this step tripped before."""
+        trip.step = int(chunk_start)
+        self.trips.append(trip)
+        self._trip_steps[chunk_start] = \
+            self._trip_steps.get(chunk_start, 0) + 1
+        _m_trips.inc()
+        if trip.rule in _m_rule:
+            _m_rule[trip.rule].inc()
+        if self._trip_steps[chunk_start] > 1:
+            _m_fatals.inc()
+            raise SentinelFatal(
+                "sentinel: REPEAT trip at step %d after rollback+quarantine "
+                "(%s%s) — divergence is systematic, not bad data; dying "
+                "with state intact for the post-mortem"
+                % (chunk_start, trip.reason,
+                   ", watchdog op %s" % trip.named_op if trip.named_op
+                   else ""), self.trips)
+        if len(self.trips) > self.max_trips:
+            _m_fatals.inc()
+            raise SentinelFatal(
+                "sentinel: rollback budget exhausted (%d trips > "
+                "max_trips=%d; last: %s)"
+                % (len(self.trips), self.max_trips, trip.reason), self.trips)
+
+    def record_rollback(self, n_quarantined: int) -> None:
+        _m_rollbacks.inc()
+        if n_quarantined:
+            _m_quarantined.inc(n_quarantined)
+
+    def apply_lr_backoff(self, scope) -> bool:
+        """Scale ``lr_var`` in ``scope`` by the backoff factor (configured
+        only; returns False when inert). NOTE: backoff intentionally
+        breaks bit-parity with an undisturbed twin — leave it off when the
+        drill's bit-identity contract matters."""
+        if self.lr_backoff is None:
+            return False
+        cur = scope.find_var(self.lr_var)
+        if cur is None:
+            from ..log import vlog
+
+            vlog(0, "sentinel: lr_var %r not found in scope; backoff "
+                    "skipped", self.lr_var)
+            return False
+        scope.set_var(self.lr_var,
+                      np.asarray(cur, np.float32) * self.lr_backoff)
+        _m_lr_backoffs.inc()
+        return True

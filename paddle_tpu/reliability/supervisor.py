@@ -1,4 +1,4 @@
-"""Preemption-aware training supervisor: checkpoint, resume, retry.
+"""Preemption-aware training supervisor: checkpoint, resume, retry, heal.
 
 :func:`run_supervised` wraps ``Executor.run_steps`` with the production
 lifecycle the bare driver lacks:
@@ -13,20 +13,29 @@ lifecycle the bare driver lacks:
 * **Auto-resume**: on entry the latest complete checkpoint is restored
   (``io.load_checkpoint``), the per-step RNG counter is rewound to the
   checkpointed step (so dropout masks and every other per-step stream
-  continue bit-identically), and the step offset is handed back to the
-  caller's ``feed_source`` so the data stream resumes in place — the
-  kill/resume drill asserts the resumed loss trajectory is bit-identical
-  to an uninterrupted run.
+  continue bit-identically), and the DATA STREAM rewinds with it: a
+  checkpointable feed source (``paddle_tpu.data.CheckpointableReader`` or
+  anything with ``state_dict``/``load_state_dict``) has its position
+  persisted inside every checkpoint and restored here — exactly-once
+  record consumption across kill/resume with **no caller bookkeeping**.
+  The legacy ``feed_source(start_step) -> iterator`` callable contract is
+  kept for back-compat.
 * **Retry**: a failed chunk is classified (:func:`~.faults.classify`);
-  transient failures retry with exponential backoff up to ``max_retries``
-  (the RNG step counter is rewound first, so a retried chunk replays the
-  exact streams of the failed attempt); fatal failures record a
-  supervisor event in the flight recorder and re-raise.
-
-The feed contract: ``feed_source(start_step)`` returns an iterator yielding
-one feed dict per step **starting at global step** ``start_step`` — the
-supervisor materializes each fused chunk before dispatching it, so a
-transient failure can replay the chunk without re-pulling data.
+  transient failures retry with exponential backoff — now with
+  deterministic seeded jitter (:func:`backoff_schedule`; restart-storm
+  avoidance) — up to ``max_retries`` (the RNG step counter is rewound
+  first, so a retried chunk replays the exact streams of the failed
+  attempt); fatal failures record a supervisor event in the flight
+  recorder and re-raise.
+* **Self-healing** (``sentinel=``): a
+  :class:`~.sentinel.DivergenceSentinel` evaluates every chunk's fetched
+  losses (and the numerics watchdog's typed exception) against its rules;
+  a trip rolls the run back to the last good checkpoint — model, RNG
+  counter and reader position together — quarantines the offending data
+  window through the reader, optionally backs off LR, and resumes. The
+  rollback budget is bounded; exhaustion or a repeat trip at the same
+  step raises :class:`~.sentinel.SentinelFatal` with the flight-recorder
+  dump carrying the watchdog-named op.
 """
 
 from __future__ import annotations
@@ -37,10 +46,13 @@ import threading
 import time
 from typing import Any, Callable, List, Optional, Sequence
 
+import numpy as np
+
 from ..monitor import device as _dev, metrics as _mx, telemetry as _telemetry
 from . import faults as _faults
 
-__all__ = ["EXIT_PREEMPTED", "SupervisorResult", "run_supervised"]
+__all__ = ["EXIT_PREEMPTED", "SupervisorResult", "run_supervised",
+           "backoff_schedule"]
 
 #: Marked exit code for a preemption-triggered checkpoint-and-exit — the
 #: restart policy treats it as "resume me", unlike a crash code.
@@ -56,11 +68,43 @@ _m_retry = _mx.counter("reliability/retries",
                        help="transient chunk failures absorbed by retry")
 
 
+def backoff_schedule(base_s: float, retries: int, seed: int = 0
+                     ) -> List[float]:
+    """The retry sleep schedule: exponential with deterministic seeded
+    multiplicative jitter in ``[0.5, 1.0)`` per attempt. Jitter decorrelates
+    a fleet of restarting workers (restart-storm avoidance) while staying
+    byte-reproducible for a fixed seed — the drill's replay contract."""
+    rng = np.random.RandomState(seed & 0x7FFFFFFF)
+    return [base_s * (2 ** a) * (0.5 + 0.5 * float(rng.random_sample()))
+            for a in range(max(0, int(retries)))]
+
+
+def _quiesce_scope(scope) -> None:
+    """Block until every jax array in ``scope`` (the fused chunk's carry)
+    has materialized. Called before a rollback replaces live state: the
+    tripping chunk's dispatch may still be executing asynchronously, and
+    overwriting (then GC-ing) its carry mid-flight is exactly the
+    lifetime hazard the restore path must not introduce."""
+    import jax
+
+    jax.block_until_ready([
+        v for v in (scope.find_var(n) for n in list(scope.vars))
+        if isinstance(v, jax.Array)])
+
+
+def _is_reader_source(src) -> bool:
+    """A checkpointable feed source: iterable with a serializable
+    position. (The legacy contract is a CALLABLE ``feed_source(start)``.)"""
+    return (hasattr(src, "state_dict") and hasattr(src, "load_state_dict")
+            and hasattr(src, "__next__"))
+
+
 class SupervisorResult:
     """Outcome of one :func:`run_supervised` invocation."""
 
     __slots__ = ("steps_done", "start_step", "resumed", "preempted",
-                 "losses", "checkpoints_written", "retries", "last_serial")
+                 "losses", "checkpoints_written", "retries", "last_serial",
+                 "trips", "rollbacks", "records_quarantined")
 
     def __init__(self):
         self.steps_done = 0        # global step index reached
@@ -71,18 +115,22 @@ class SupervisorResult:
         self.checkpoints_written = 0
         self.retries = 0
         self.last_serial: Optional[int] = None
+        self.trips: List[Any] = []   # SentinelTrip records, in trip order
+        self.rollbacks = 0
+        self.records_quarantined = 0
 
     def __repr__(self):
         return ("SupervisorResult(steps=%d from %d, resumed=%s, preempted=%s,"
-                " ckpts=%d, retries=%d)"
+                " ckpts=%d, retries=%d, trips=%d, rollbacks=%d)"
                 % (self.steps_done, self.start_step, self.resumed,
-                   self.preempted, self.checkpoints_written, self.retries))
+                   self.preempted, self.checkpoints_written, self.retries,
+                   len(self.trips), self.rollbacks))
 
 
 def run_supervised(
     exe,
     program,
-    feed_source: Callable[[int], Any],
+    feed_source,
     total_steps: int,
     fetch_list: Optional[Sequence] = None,
     *,
@@ -92,28 +140,63 @@ def run_supervised(
     checkpoint_every_s: float = 0.0,
     max_retries: int = 3,
     backoff_s: float = 0.05,
+    backoff_seed: Optional[int] = None,
     trainer_id: int = 0,
     max_num_checkpoints: int = 3,
     exit_on_preempt: bool = True,
     install_signal_handlers: bool = True,
+    sentinel=None,
+    on_chunk: Optional[Callable[[int, List[Any]], None]] = None,
 ) -> SupervisorResult:
     """Drive ``total_steps`` training steps with preemption handling,
-    rotating checkpoints, auto-resume and bounded transient retry.
+    rotating checkpoints, auto-resume, bounded jittered retry and
+    (optionally) sentinel-guarded rollback healing.
 
-    ``feed_source(start_step)`` must return an iterator of per-step feed
-    dicts beginning at ``start_step``. Fetches (``fetch_list``) come back
-    in ``result.losses``, one numpy row per step executed by THIS call
-    (resumed steps before ``start_step`` belong to the previous life).
+    ``feed_source`` is either the legacy callable — ``feed_source(start)``
+    returns an iterator of per-step feed dicts beginning at global step
+    ``start`` — or a checkpointable reader (``state_dict`` /
+    ``load_state_dict`` / iteration): its position is folded into every
+    checkpoint and restored on resume/rollback automatically. Fetches
+    (``fetch_list``) come back in ``result.losses``, one numpy row per
+    step executed by THIS call. ``on_chunk(start_step, rows)`` fires after
+    every *committed* fused chunk (never for a chunk a sentinel trip threw
+    away) — the hook progress ledgers and checkpoint-external bookkeeping
+    ride on. ``backoff_seed`` seeds the retry jitter (default: the active
+    fault plan's seed, else 0), see :func:`backoff_schedule`.
     """
     from .. import io as _io
+    from ..core.scope import global_scope
+    from . import sentinel as _sent  # typed fatals in the rollback path
 
     res = SupervisorResult()
+    reader_mode = _is_reader_source(feed_source)
     args = _io.load_checkpoint(exe, checkpoint_dir, program)
     if args is not None:
         res.resumed = True
         res.start_step = int(args.get("step", 0))
         _m_resume.inc()
     start = res.start_step
+    if reader_mode:
+        state = (args or {}).get("data_reader")
+        if state is not None:
+            feed_source.load_state_dict(state)
+        elif start > 0:
+            # checkpoint predates reader-state payloads: fast-forward by
+            # consuming `start` batches so at least the position matches
+            # the step (logged — exactly-once needs the state payload)
+            from ..log import vlog
+
+            vlog(0, "run_supervised: checkpoint at step %d carries no "
+                    "data_reader state; fast-forwarding the reader by "
+                    "consuming %d batches", start, start)
+            for _ in range(start):
+                try:
+                    next(feed_source)
+                except StopIteration:
+                    break
+        it = iter(feed_source)
+    else:
+        it = iter(feed_source(start))
     # Rewind the per-step RNG counter to the resume point: the compiled step
     # folds this counter into every stochastic op's key, so restoring it is
     # what makes the resumed trajectory bit-identical, dropout included.
@@ -131,19 +214,109 @@ def run_supervised(
             installed.append((sig, signal.signal(sig, _on_signal)))
 
     def _checkpoint(step: int) -> None:
+        targs = {"step": step}
+        if reader_mode:
+            targs["data_reader"] = feed_source.state_dict()
         serial = _io.save_checkpoint(
             exe, checkpoint_dir, program, trainer_id=trainer_id,
-            trainer_args={"step": step},
-            max_num_checkpoints=max_num_checkpoints)
+            trainer_args=targs, max_num_checkpoints=max_num_checkpoints)
         res.last_serial = serial
         res.checkpoints_written += 1
         _m_ckpt.inc()
 
-    it = iter(feed_source(start))
     k = max(1, int(fetch_every))
     last_ckpt_step = start
     last_ckpt_t = time.monotonic()
     fr = _dev.flight_recorder()
+    if backoff_seed is None:
+        plan = _faults.current_plan()
+        backoff_seed = plan.seed if plan is not None else 0
+    sleeps = backoff_schedule(backoff_s, max_retries, seed=backoff_seed) \
+        if backoff_s else [0.0] * max_retries
+
+    if sentinel is not None and args is None:
+        # the rollback floor: a trip before the first periodic checkpoint
+        # must still have a known-good serial to return to
+        _checkpoint(start)
+        last_ckpt_step = start
+
+    def _sentinel_rollback(trip, chunk_len: int) -> None:
+        """Roll back to the last good checkpoint: model + optimizer state,
+        RNG counter, reader position — then quarantine the tripping data
+        window so the replay (and every later epoch) skips it."""
+        chunk_start = res.steps_done
+        try:
+            sentinel.register_trip(chunk_start, trip)  # may raise Fatal
+        except Exception as fatal:
+            res.trips = list(sentinel.trips)
+            if fr is not None:
+                fr.record_event(
+                    "sentinel_fatal", step=chunk_start, trip=trip.to_doc(),
+                    trips=[t.to_doc() for t in sentinel.trips])
+                try:  # the post-mortem artifact, watchdog-named op included
+                    fr.dump("sentinel_fatal", fatal)
+                except Exception:
+                    pass  # an unwritable dir never masks the fatal
+            raise
+        res.trips = list(sentinel.trips)
+        window_ids: List[str] = []
+        if reader_mode and hasattr(feed_source, "last_batch_ids"):
+            batches = feed_source.last_batch_ids(chunk_len)
+            if len(batches) < chunk_len:
+                from ..log import vlog
+
+                vlog(0, "sentinel: id history holds %d of the %d tripping "
+                        "batches — quarantining the known suffix only",
+                     len(batches), chunk_len)
+            window_ids = [rid for b in batches for rid in b]
+        # Quiesce before the restore overwrites live device state: the
+        # tripping chunk's dispatch may still be in flight, and replacing
+        # (then GC-ing) its carry mid-execution races the async runtime.
+        _quiesce_scope(global_scope())
+        rb_args = _io.load_checkpoint(exe, checkpoint_dir, program)
+        if rb_args is None:
+            raise _sent.SentinelFatal(
+                "sentinel: trip at step %d but no checkpoint to roll back "
+                "to in %r (%s)" % (chunk_start, checkpoint_dir, trip.reason),
+                sentinel.trips)
+        good_step = int(rb_args.get("step", 0))
+        if reader_mode:
+            state = rb_args.get("data_reader")
+            if state is not None:
+                feed_source.load_state_dict(state)
+            else:
+                # a legacy serial (pre-reader-payload) can't rewind the
+                # stream: the replay will train LATER records at earlier
+                # steps — say so loudly instead of silently skewing
+                from ..log import vlog
+
+                vlog(0, "sentinel rollback: checkpoint serial at step %d "
+                        "carries no data_reader state — the reader cannot "
+                        "rewind, model and data stream are now skewed "
+                        "(re-checkpoint with this build to heal)",
+                     good_step)
+            if window_ids and hasattr(feed_source, "quarantine"):
+                feed_source.quarantine(
+                    window_ids, "sentinel %s trip at step %d: %s"
+                    % (trip.rule, chunk_start, trip.reason))
+        else:
+            nonlocal it
+            it = iter(feed_source(good_step))
+        program._tpu_step_counter = good_step
+        del res.losses[good_step - res.start_step:]
+        res.steps_done = good_step
+        res.rollbacks += 1
+        res.records_quarantined += len(window_ids)
+        sentinel.record_rollback(len(window_ids))
+        sentinel.apply_lr_backoff(global_scope())
+        if fr is not None:
+            fr.record_event(
+                "sentinel_trip", step=chunk_start, rolled_back_to=good_step,
+                trip=trip.to_doc(), quarantined=len(window_ids))
+        nonlocal last_ckpt_step, last_ckpt_t
+        last_ckpt_step = good_step
+        last_ckpt_t = time.monotonic()
+
     # continuous telemetry rides the supervised run's lifetime: the JSONL
     # ring streams while training, and the final release (in the finally
     # below) flushes the last PARTIAL interval so a preempted or failed
@@ -164,6 +337,7 @@ def run_supervised(
 
             counter0 = getattr(program, "_tpu_step_counter", res.steps_done)
             attempt = 0
+            rows = None
             while True:
                 try:
                     rows = exe.run_steps(
@@ -171,6 +345,11 @@ def run_supervised(
                         fetch_list=fetch_list, fetch_every=len(chunk))
                     break
                 except Exception as e:
+                    trip = sentinel.check_exception(e) \
+                        if sentinel is not None else None
+                    if trip is not None:
+                        _sentinel_rollback(trip, len(chunk))
+                        break  # rows stays None: chunk discarded
                     kind = _faults.classify(e)
                     if kind == "transient" and attempt < max_retries:
                         attempt += 1
@@ -180,8 +359,8 @@ def run_supervised(
                         # chunk may have advanced: the retry must replay
                         # the SAME per-step streams
                         program._tpu_step_counter = counter0
-                        if backoff_s:
-                            time.sleep(backoff_s * (2 ** (attempt - 1)))
+                        if sleeps[attempt - 1]:
+                            time.sleep(sleeps[attempt - 1])
                         continue
                     if fr is None:
                         fr = _dev.flight_recorder()
@@ -191,8 +370,21 @@ def run_supervised(
                             classified=kind, attempts=attempt,
                             error="%s: %s" % (type(e).__name__, e))
                     raise
+            if rows is None:
+                continue  # sentinel rolled back on the exception path
+            if sentinel is not None:
+                # only the trailing rule window, not O(steps-so-far)
+                tail = res.losses[-sentinel.history_window():]
+                history = [sentinel._loss(r) for r in tail]
+                trip = sentinel.check_rows(rows, history)
+                if trip is not None:
+                    _sentinel_rollback(trip, len(chunk))
+                    continue
             res.losses.extend(rows)
+            chunk_start = res.steps_done
             res.steps_done += len(chunk)
+            if on_chunk is not None:
+                on_chunk(chunk_start, rows)
 
             due = False
             if checkpoint_every_steps and \

@@ -38,14 +38,15 @@ from .engine import ServingConfig, ServingEngine  # noqa: F401
 from .kv_cache import ContiguousKVCache, PagedKVCache  # noqa: F401
 from .page_pool import PagePool, PagePoolExhausted  # noqa: F401
 from .request import (  # noqa: F401
-    FAILED, FINISHED, QUEUED, RUNNING, TIMEOUT, BackpressureError, Request)
+    FAILED, FINISHED, QUEUED, REJECTED, RUNNING, TIMEOUT, BackpressureError,
+    DrainingError, Request)
 from .scheduler import Scheduler  # noqa: F401
 
 __all__ = [
     "ServingConfig", "ServingEngine",
     "PagedKVCache", "ContiguousKVCache",
     "PagePool", "PagePoolExhausted",
-    "Scheduler", "Request", "BackpressureError",
-    "QUEUED", "RUNNING", "FINISHED", "TIMEOUT", "FAILED",
+    "Scheduler", "Request", "BackpressureError", "DrainingError",
+    "QUEUED", "RUNNING", "FINISHED", "TIMEOUT", "FAILED", "REJECTED",
     "trace",
 ]

@@ -38,7 +38,8 @@ from . import metrics as _sm
 from . import trace as _trace
 from .kv_cache import ContiguousKVCache, PagedKVCache
 from .page_pool import PagePool, PagePoolExhausted
-from .request import FAILED, FINISHED, TIMEOUT, Request
+from .request import (FAILED, FINISHED, REJECTED, TIMEOUT, DrainingError,
+                      Request)
 from .scheduler import Scheduler
 
 __all__ = ["ServingConfig", "ServingEngine"]
@@ -97,7 +98,8 @@ class ServingConfig:
                  continuous: bool = True, collect_logits: bool = False,
                  pad_id: int = 0, decode_retries: int = 2,
                  fail_fast: bool = False,
-                 slos: Optional[Sequence] = None):
+                 slos: Optional[Sequence] = None,
+                 drain_timeout_s: float = 30.0):
         if max_seq % page_size != 0:
             raise ValueError("max_seq=%d must be a multiple of page_size=%d"
                              % (max_seq, page_size))
@@ -125,6 +127,7 @@ class ServingConfig:
         self.decode_retries = max(0, int(decode_retries))
         self.fail_fast = bool(fail_fast)
         self.slos = list(slos) if slos else []
+        self.drain_timeout_s = float(drain_timeout_s)
 
     def _tuned_decode_fuse(self):
         """(value, source) from the autotuned config table; (1, "default")
@@ -186,6 +189,8 @@ class ServingEngine:
         self._faults_absorbed = 0
         self._last_error: Optional[str] = None
         self._closed = False
+        self._draining = False
+        self.last_drain: Optional[dict] = None
         # continuous telemetry: refcounted process exporter (None when
         # PADDLE_TPU_TELEMETRY_DIR is unset — that check is one env read)
         self._telemetry = _telemetry.acquire()
@@ -247,6 +252,11 @@ class ServingEngine:
         bounds the request's wall-clock life from submission: past it the
         request is retired with TIMEOUT status (queued or running) so it
         stops pinning a slot and KV pages."""
+        if self._draining:
+            _sm.DRAIN_REJECTED.inc()
+            raise DrainingError(
+                "engine is draining (graceful shutdown): not admitting new "
+                "requests — re-route to a peer")
         req = Request(prompt, max_new_tokens, deadline_s=deadline_s)
         if req.prompt_len > self.cfg.prompt_buckets[-1]:
             raise ValueError(
@@ -279,12 +289,18 @@ class ServingEngine:
 
     def run(self, max_steps: Optional[int] = None) -> List[Request]:
         """Drive :meth:`step` until queue and slots drain (or ``max_steps``).
-        Updates the ``serving/tokens_per_sec`` gauge over the drive."""
+        Updates the ``serving/tokens_per_sec`` gauge over the drive. A
+        :meth:`request_drain` arriving mid-drive (a SIGTERM handler) flips
+        the loop into :meth:`drain`: in-flight requests finish, queued
+        ones are shed, the engine closes."""
         t0 = time.perf_counter()
         tok0 = _sm.TOKENS_GENERATED.value
         done: List[Request] = []
         steps = 0
         while not self.scheduler.idle():
+            if self._draining:
+                self.drain()
+                break
             if max_steps is not None and steps >= max_steps:
                 break
             done.extend(self.step())
@@ -293,6 +309,53 @@ class ServingEngine:
         if dt > 0:
             _sm.TOKENS_PER_SEC.set((_sm.TOKENS_GENERATED.value - tok0) / dt)
         return done
+
+    def request_drain(self) -> None:
+        """Signal-handler-safe drain request: new submissions start
+        rejecting typed (:class:`~.request.DrainingError`) immediately;
+        the driving loop (:meth:`run`) performs the actual drain at the
+        next cycle boundary instead of tearing down mid-decode."""
+        self._draining = True
+
+    def drain(self, timeout_s: Optional[float] = None) -> dict:
+        """Graceful shutdown: stop admitting (queued requests are shed
+        with terminal state REJECTED — they never held slots or pages),
+        FINISH the in-flight requests (continuing the normal decode loop,
+        bounded by ``timeout_s``; stragglers past it retire TIMEOUT with
+        their pages reclaimed), then :meth:`close`. Returns and stores
+        (``engine.last_drain``) a summary dict; ticks ``serving/drains``
+        and ``serving/drained_requests``. Idempotent: a second drain on a
+        drained engine returns the recorded summary untouched."""
+        if self._closed and self.last_drain is not None:
+            return self.last_drain
+        if timeout_s is None:
+            timeout_s = self.cfg.drain_timeout_s
+        summary = {"finished": 0, "timed_out": 0, "failed": 0,
+                   "rejected": 0}
+        self._draining = True
+        _sm.DRAINS.inc()
+        now = time.perf_counter()
+        for req in self.scheduler.drain_queue():
+            req.finished_t = now
+            _trace.on_terminal(req, REJECTED, None)
+            summary["rejected"] += 1
+        deadline = time.monotonic() + timeout_s
+        while self.scheduler.occupancy and time.monotonic() < deadline:
+            for req in self.step():
+                key = {FINISHED: "finished", TIMEOUT: "timed_out",
+                       FAILED: "failed"}.get(req.state)
+                if key is not None:
+                    summary[key] += 1
+        for slot in range(self.cfg.slots):
+            if self.scheduler.slot_request(slot) is not None:
+                # past the drain budget: cut the straggler loose — TIMEOUT
+                # is its terminal state, pages return to the pool
+                self._retire(slot, state=TIMEOUT)
+                summary["timed_out"] += 1
+        _sm.DRAINED_REQUESTS.inc(summary["finished"])
+        self.last_drain = summary
+        self.close()
+        return summary
 
     def captured_logits(self, req: Request) -> List[np.ndarray]:
         """Per-emitted-token logits rows (``collect_logits=True`` only)."""

@@ -19,6 +19,7 @@ __all__ = [
     "TOKENS_GENERATED", "TOKENS_PER_SEC",
     "REQUEST_LATENCY_MS", "TTFT_MS", "DECODE_STEP_MS", "PREFILL_MS",
     "FAULTS", "RETRIES", "TIMEOUTS", "REQUESTS_FAILED",
+    "DRAINS", "DRAINED_REQUESTS", "DRAIN_REJECTED",
 ]
 
 REQUESTS_SUBMITTED = _mx.counter(
@@ -79,3 +80,15 @@ REQUESTS_FAILED = _mx.counter(
     "serving/requests_failed",
     help="requests retired as FAILED when their in-flight batch was lost "
          "to a decode failure")
+DRAINS = _mx.counter(
+    "serving/drains",
+    help="graceful drains performed (stop admitting, finish in-flight, "
+         "close) — SIGTERM/rollout shutdowns, not crashes")
+DRAINED_REQUESTS = _mx.counter(
+    "serving/drained_requests",
+    help="in-flight requests that FINISHED during a graceful drain")
+DRAIN_REJECTED = _mx.counter(
+    "serving/drain_rejected",
+    help="requests rejected because the engine was draining (typed "
+         "DrainingError at submit, plus queued requests shed at drain "
+         "start)")

@@ -14,7 +14,7 @@ import itertools
 import time
 from typing import List, Optional, Sequence
 
-__all__ = ["Request", "BackpressureError",
+__all__ = ["Request", "BackpressureError", "DrainingError",
            "QUEUED", "RUNNING", "FINISHED", "REJECTED",
            "TIMEOUT", "FAILED"]
 
@@ -33,6 +33,13 @@ class BackpressureError(RuntimeError):
     full, or — via the :class:`~.page_pool.PagePoolExhausted` subclass — no
     KV pages left). Deliberately a distinct type: callers shed or retry;
     it never signals a crash."""
+
+
+class DrainingError(BackpressureError):
+    """The engine is draining (graceful shutdown: SIGTERM, rollout) — it
+    stopped admitting and will finish in-flight work then close. Unlike
+    queue backpressure, retrying THIS engine is pointless; the caller
+    re-routes to a peer."""
 
 
 class Request:

@@ -1,0 +1,88 @@
+"""Subprocess entry for the SIGKILL exactly-once drill in
+test_data_pipeline.py.
+
+Runs ``run_supervised`` over a ``CheckpointableReader`` (the reader is
+created FRESH each invocation — zero caller-side ``feed_source(start)``
+logic; the supervisor restores its position from the checkpoint payload).
+Usage::
+
+    python data_runner.py <shard_dir> <checkpoint_dir> <total_steps>
+
+Environment:
+  DATA_KILL_AT_STEP  SIGKILL *this* process right after the chunk ending
+                     at that global step commits — a hard crash with no
+                     checkpoint-on-exit, the worst-case kill the
+                     exactly-once ledger must survive.
+
+Prints one ``LEDGER:<step>:<id,id,...>`` line per committed step (flushed
+BEFORE the kill check so the parent sees the final pre-crash commit), one
+``SUP_STEP:<step>:<loss-bits-hex>`` per step at exit, and
+``SUP_RESUMED:<start>`` when a checkpoint was restored.
+"""
+
+import os
+import signal
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np
+
+
+def main():
+    shard_dir, ckpt_dir, total = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    kill_at = int(os.environ.get("DATA_KILL_AT_STEP", "-1"))
+
+    import paddle_tpu as fluid
+    from paddle_tpu import data
+    from paddle_tpu.reliability import run_supervised
+
+    paths = sorted(os.path.join(shard_dir, f)
+                   for f in os.listdir(shard_dir) if f.endswith(".txt"))
+
+    def parse(line):
+        t = line.split()
+        return {"x": np.asarray([float(v) for v in t[:8]], np.float32),
+                "y": np.asarray([int(t[8])], np.int64)}
+
+    reader = data.CheckpointableReader(
+        paths, parse, batch_size=4,
+        schema=[data.FieldSpec("x", (8,), np.float32),
+                data.FieldSpec("y", (1,), np.int64)],
+        epochs=1)
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = startup.random_seed = 77
+    with fluid.program_guard(main_prog, startup):
+        x = fluid.layers.data("x", shape=[8])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        h = fluid.layers.dropout(h, dropout_prob=0.3)
+        logits = fluid.layers.fc(h, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    def on_chunk(step0, rows):
+        ids = reader.last_batch_ids(len(rows))
+        for i, batch in enumerate(ids):
+            print("LEDGER:%d:%s" % (step0 + i, ",".join(batch)), flush=True)
+        if 0 <= kill_at < step0 + len(rows):
+            os.kill(os.getpid(), signal.SIGKILL)  # no cleanup, no checkpoint
+
+    res = run_supervised(
+        exe, main_prog, reader, total, [loss],
+        checkpoint_dir=ckpt_dir, fetch_every=2, checkpoint_every_steps=2,
+        backoff_s=0.0, exit_on_preempt=False, on_chunk=on_chunk)
+    if res.resumed:
+        print("SUP_RESUMED:%d" % res.start_step)
+    for i, row in enumerate(res.losses):
+        print("SUP_STEP:%d:%s"
+              % (res.start_step + i, np.float32(row[0]).tobytes().hex()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,484 @@
+"""Exactly-once, corruption-tolerant data pipeline + divergence sentinel
+(ISSUE 10).
+
+Covers: CheckpointableReader position round-trip (exactly-once across a
+state_dict/load_state_dict boundary), typed corrupt-record quarantine with
+per-record reasons, the bounded corrupt-rate -> DataCorruptionError
+contract, prefetch state consistency (the wrapper's state is the
+consumer's, not the worker's read-ahead), MultiSlot/AsyncExecutor feed
+parity, reader-fed run_supervised resume with zero caller bookkeeping
+(in-process preempt + subprocess SIGKILL, both asserting the record-id
+ledger), checkpoint torn-restore with the new data-reader payload (model
+and reader fall back to the SAME serial), the divergence sentinel
+(NaN-window rollback healing bit-identical to a never-poisoned twin,
+spike rule, trip budget, repeat-trip fatality, watchdog op naming), and
+the supervisor's seeded-jitter backoff schedule."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import data
+from paddle_tpu.reliability import (DivergenceSentinel, FaultPlan,
+                                    SentinelFatal, backoff_schedule, faults,
+                                    run_supervised)
+
+_RUNNER = os.path.join(os.path.dirname(__file__), "data_runner.py")
+
+
+# -- shard helpers ------------------------------------------------------------
+
+def _write_shards(dirname, n, n_shards=2, poison=(), seed_base=6000):
+    """Shards of ``8 floats + 1 int label`` records; indices in ``poison``
+    get all-NaN features (parseable + schema-valid — numerically toxic)."""
+    os.makedirs(dirname, exist_ok=True)
+    paths, idx = [], 0
+    per = n // n_shards
+    for si in range(n_shards):
+        p = os.path.join(dirname, "shard_%d.txt" % si)
+        with open(p, "w") as f:
+            for _ in range(per):
+                r = np.random.RandomState(seed_base + idx)
+                x = np.full(8, np.nan) if idx in poison else r.randn(8)
+                f.write(" ".join("%r" % float(v) for v in x)
+                        + " %d\n" % r.randint(0, 4))
+                idx += 1
+        paths.append(p)
+    return paths
+
+
+def _parse(line):
+    t = line.split()
+    return {"x": np.asarray([float(v) for v in t[:8]], np.float32),
+            "y": np.asarray([int(t[8])], np.int64)}
+
+
+_SCHEMA = [data.FieldSpec("x", (8,), np.float32),
+           data.FieldSpec("y", (1,), np.int64)]
+
+
+def _reader(paths, batch_size=4, **kw):
+    kw.setdefault("epochs", 1)
+    return data.CheckpointableReader(paths, _parse, batch_size,
+                                     schema=_SCHEMA, **kw)
+
+
+# -- reader core --------------------------------------------------------------
+
+def test_reader_position_roundtrip_exactly_once(tmp_path):
+    paths = _write_shards(str(tmp_path), 24)
+    ref = list(_reader(paths))
+    r1 = _reader(paths)
+    head = [next(r1) for _ in range(2)]
+    state = r1.state_dict()
+    tail1 = list(r1)
+    r2 = _reader(paths)
+    r2.load_state_dict(state)
+    tail2 = list(r2)
+    assert len(head) + len(tail1) == len(ref) == 6
+    for a, b in zip(tail1, tail2):
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
+    # the restored reader's ledger continues exactly where the state says
+    assert r2.state_dict()["records_read"] == 24
+    # a different shard set refuses the state (silent skew prevention)
+    other = _write_shards(str(tmp_path / "other"), 24)
+    os.rename(other[0], other[0].replace("shard_0", "renamed_0"))
+    r3 = data.CheckpointableReader(
+        sorted(os.path.join(str(tmp_path / "other"), f)
+               for f in os.listdir(str(tmp_path / "other"))),
+        _parse, 4, schema=_SCHEMA, epochs=1)
+    with pytest.raises(ValueError, match="different records"):
+        r3.load_state_dict(state)
+
+
+def test_corrupt_records_quarantined_with_reasons(tmp_path):
+    p = os.path.join(str(tmp_path), "bad_0.txt")
+    with open(p, "w") as f:
+        f.write(" ".join(["0.1"] * 8) + " 1\n")      # good
+        f.write("not numbers at all\n")               # parse failure
+        f.write(" ".join(["0.2"] * 4) + " 1\n")      # wrong width (shape)
+        f.write(" ".join(["0.3"] * 8) + " 2\n")      # good
+        f.write(" ".join(["0.4"] * 8) + " 0\n")      # good
+        f.write(" ".join(["0.5"] * 8) + " 3\n")      # good
+    q = os.path.join(str(tmp_path), "quarantine.jsonl")
+    r = _reader([p], batch_size=2, quarantine_path=q,
+                max_corrupt_rate=0.9, corrupt_check_min=1)
+    batches = list(r)
+    assert len(batches) == 2 and r.records_corrupt == 2
+    rows = [json.loads(ln) for ln in open(q)]
+    assert [row["id"] for row in rows] == ["bad_0.txt#1", "bad_0.txt#2"]
+    assert all("parse" in row["reason"] for row in rows)
+    # quarantined ids persist into the skip set and the state dict
+    assert r.quarantined_ids() == ["bad_0.txt#1", "bad_0.txt#2"]
+    assert sorted(r.state_dict()["skip_ids"]) == r.quarantined_ids()
+
+
+def test_corrupt_rate_bound_raises_typed(tmp_path):
+    p = os.path.join(str(tmp_path), "mostly_bad_0.txt")
+    with open(p, "w") as f:
+        for i in range(20):
+            f.write("garbage\n" if i % 2 else
+                    " ".join(["0.1"] * 8) + " 1\n")
+    r = _reader([p], batch_size=2, max_corrupt_rate=0.1, corrupt_check_min=4)
+    with pytest.raises(data.DataCorruptionError, match="exceeds the"):
+        list(r)
+
+
+def test_prefetch_preserves_checkpoint_contract(tmp_path):
+    paths = _write_shards(str(tmp_path), 32)
+    ref = list(_reader(paths))
+    pf = _reader(paths).prefetch(capacity=3)
+    got = [next(pf) for _ in range(3)]
+    state = pf.state_dict()  # position of the LAST YIELDED batch only
+    assert state["records_read"] == 12, state
+    # a fresh reader restored from the prefetcher's state continues in step
+    r2 = _reader(paths)
+    r2.load_state_dict(state)
+    rest = list(r2)
+    assert len(got) + len(rest) == len(ref)
+    for a, b in zip(got + rest, ref):
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
+    pf.stop()
+    # quarantine through the wrapper rewinds the worker's read-ahead: the
+    # NEXT batches skip the named records exactly as an unwrapped reader
+    pf2 = _reader(paths).prefetch(capacity=2)
+    next(pf2)
+    ids_next = ["shard_0.txt#4", "shard_0.txt#5"]
+    pf2.quarantine(ids_next, "test window")
+    after = next(pf2)
+    r3 = _reader(paths)
+    [next(r3)]
+    r3.quarantine(ids_next, "test window")
+    expect = next(r3)
+    for k in after:
+        np.testing.assert_array_equal(after[k], expect[k])
+    pf2.stop()
+
+
+def test_multislot_asyncexecutor_feed_parity(tmp_path):
+    from paddle_tpu.async_executor import (_batch_to_feed,
+                                           _parse_multislot_line)
+
+    paths = data.write_ctr_shards(str(tmp_path), 12, n_shards=1,
+                                  num_fields=5, dense_dim=3, vocab=100)
+    slots = data.ctr_slots(num_fields=5, dense_dim=3)
+    reader = data.MultiSlotTextReader(paths, slots, batch_size=4, epochs=1)
+    ref_batches = []
+    batch = []
+    for line in open(paths[0]):
+        batch.append(_parse_multislot_line(line.strip(), slots))
+        if len(batch) == 4:
+            ref_batches.append(_batch_to_feed(batch, slots))
+            batch = []
+    got = list(reader)
+    assert len(got) == len(ref_batches) == 3
+    for a, b in zip(got, ref_batches):
+        assert sorted(a) == sorted(b)
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_ctr_reader_feeds_deepfm(tmp_path):
+    from paddle_tpu.models import deepfm as dfm
+
+    paths = data.write_ctr_shards(str(tmp_path), 16, n_shards=2,
+                                  num_fields=4, dense_dim=3, vocab=50)
+    reader = data.CTRMultiSlotReader(paths, batch_size=8, num_fields=4,
+                                     dense_dim=3, vocab=50, epochs=1)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[4], dtype="int64")
+        dense = fluid.layers.data("dense", shape=[3])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        _, loss, _ = dfm.deepfm(ids, dense, label, sparse_feature_dim=50,
+                                embedding_size=4, num_fields=4,
+                                layer_sizes=(8,), is_sparse=False)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    # composes with DevicePrefetcher: parse-ahead -> H2D-ahead -> run_steps
+    from paddle_tpu.reader import DevicePrefetcher
+
+    with DevicePrefetcher(reader.prefetch(2), capacity=2) as feeds:
+        rows = exe.run_steps(main, feeds, steps=2, fetch_list=[loss],
+                             fetch_every=2)
+    assert len(rows) == 2 and all(np.isfinite(r[0]).all() for r in rows)
+
+
+# -- supervised integration: exactly-once with zero caller bookkeeping --------
+
+def _build_train():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 1234
+    with fluid.unique_name.guard():
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8])
+            y = fluid.layers.data("y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(x, size=16, act="relu")
+            h = fluid.layers.dropout(h, dropout_prob=0.3)
+            logits = fluid.layers.fc(h, size=4)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+    return main, startup, loss
+
+
+def _supervised(ckpt, reader, plan=None, total=8, sentinel=None,
+                ledger=None):
+    main, startup, loss = _build_train()
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    def on_chunk(step0, rows):
+        if ledger is not None:
+            for i, ids in enumerate(reader.last_batch_ids(len(rows))):
+                ledger[step0 + i] = ids
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with (plan if plan is not None else FaultPlan([])):
+            return run_supervised(
+                exe, main, reader, total, [loss], checkpoint_dir=ckpt,
+                fetch_every=2, checkpoint_every_steps=2, backoff_s=0.0,
+                exit_on_preempt=False, sentinel=sentinel, on_chunk=on_chunk)
+
+
+def _bits(v):
+    return np.float32(v).tobytes().hex()
+
+
+def test_supervised_reader_preempt_resume_exactly_once(tmp_path):
+    paths = _write_shards(str(tmp_path / "shards"), 40)
+    ref_led = {}
+    ref = _supervised(str(tmp_path / "ref"), _reader(paths), ledger=ref_led)
+    assert ref.steps_done == 8
+
+    ck = str(tmp_path / "ck")
+    led1, led2 = {}, {}
+    plan = FaultPlan([faults.FaultSpec("executor.dispatch", "preempt", at=2)])
+    first = _supervised(ck, _reader(paths), plan, ledger=led1)
+    assert first.preempted and first.steps_done == 4
+    # the resume uses a FRESH reader object: the supervisor restores its
+    # position from the checkpoint payload, no feed_source(start) anywhere
+    second = _supervised(ck, _reader(paths), ledger=led2)
+    assert second.resumed and second.start_step == 4
+    assert second.steps_done == 8 and not second.preempted
+
+    stitched = dict(led1)
+    stitched.update(led2)
+    consumed = [rid for s in sorted(stitched) for rid in stitched[s]]
+    assert sorted(stitched) == list(range(8))
+    assert len(consumed) == len(set(consumed)) == 32
+    assert stitched == ref_led
+    sb = [_bits(r[0]) for r in first.losses] + \
+         [_bits(r[0]) for r in second.losses]
+    assert sb == [_bits(r[0]) for r in ref.losses]
+
+
+def _run_data_runner(shards, ckpt, total=8, kill_at=None, timeout=120):
+    env = dict(os.environ)
+    env.pop("PYTEST_CURRENT_TEST", None)
+    env.pop("PADDLE_TPU_FAULT_PLAN", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    if kill_at is not None:
+        env["DATA_KILL_AT_STEP"] = str(kill_at)
+    else:
+        env.pop("DATA_KILL_AT_STEP", None)
+    p = subprocess.run([sys.executable, _RUNNER, shards, ckpt, str(total)],
+                       env=env, stdout=subprocess.PIPE,
+                       stderr=subprocess.STDOUT, text=True, timeout=timeout)
+    ledger = {int(s): ids.split(",") for s, ids in
+              re.findall(r"LEDGER:(\d+):(\S+)", p.stdout)}
+    losses = {int(s): h for s, h in
+              re.findall(r"SUP_STEP:(\d+):([0-9a-f]{8})", p.stdout)}
+    return p, ledger, losses
+
+
+def test_sigkill_resume_record_ledger_exactly_once(tmp_path):
+    """SIGKILL (no checkpoint-on-exit, no cleanup) mid-run + auto-resume:
+    the latest-wins stitched per-step ledger consumes every record exactly
+    once and matches an uninterrupted twin — acceptance drill 2."""
+    shards = str(tmp_path / "shards")
+    _write_shards(shards, 40)
+    ref_p, ref_led, ref_losses = _run_data_runner(
+        shards, str(tmp_path / "ref"))
+    assert ref_p.returncode == 0, ref_p.stdout
+    assert sorted(ref_led) == list(range(8))
+
+    ck = str(tmp_path / "ck")
+    first_p, led1, _ = _run_data_runner(shards, ck, kill_at=5)
+    assert first_p.returncode == -9, first_p.stdout  # died to SIGKILL
+    assert sorted(led1) == list(range(6)), first_p.stdout
+
+    second_p, led2, second_losses = _run_data_runner(shards, ck)
+    assert second_p.returncode == 0, second_p.stdout
+    m = re.search(r"SUP_RESUMED:(\d+)", second_p.stdout)
+    assert m, second_p.stdout
+    resume_at = int(m.group(1))
+    assert 0 < resume_at <= 5  # last durable checkpoint before the kill
+
+    stitched = dict(led1)
+    stitched.update(led2)  # re-executed steps: the resumed life wins
+    consumed = [rid for s in sorted(stitched) for rid in stitched[s]]
+    assert sorted(stitched) == list(range(8))
+    assert len(consumed) == len(set(consumed)) == 32, \
+        "records lost or double-consumed across the SIGKILL boundary"
+    assert stitched == ref_led, "ledger differs from the uninterrupted twin"
+    # and the resumed losses are bit-identical to the twin's
+    for s, h in second_losses.items():
+        assert ref_losses[s] == h, "step %d loss diverged" % s
+
+
+def test_torn_restore_reader_state_matches_model(tmp_path):
+    """Satellite: newest serial torn (payload unreadable though _SUCCESS
+    exists) -> load falls back to the previous serial, and the reader
+    resumes from THAT serial's position — model and data can't skew."""
+    paths = _write_shards(str(tmp_path / "shards"), 40)
+    ck = str(tmp_path / "ck")
+    reader = _reader(paths)
+    res = _supervised(ck, reader, total=6)
+    assert res.steps_done == 6 and res.checkpoints_written >= 2
+    serials = sorted(int(n.split("_")[1]) for n in os.listdir(ck)
+                     if n.startswith("checkpoint_"))
+    newest = os.path.join(ck, "checkpoint_%d" % serials[-1])
+    prev = os.path.join(ck, "checkpoint_%d" % serials[-2])
+    prev_args = json.load(open(os.path.join(prev, "trainer_args.json")))
+    # corrupt the newest payload (torn write that survived _SUCCESS)
+    victims = [f for f in os.listdir(newest) if f.endswith(".npy")]
+    with open(os.path.join(newest, victims[0]), "wb") as f:
+        f.write(b"\x93NUMPY")
+    fresh = _reader(paths)
+    resumed = _supervised(ck, fresh, total=6)
+    assert resumed.resumed
+    assert resumed.start_step == prev_args["step"], \
+        "model fell back but not to the serial the reader resumed from"
+    # the reader position restored == the position stored WITH that serial
+    assert fresh.state_dict()["records_read"] == 6 * 4  # ran to step 6
+    ledger_start = prev_args["data_reader"]["records_read"]
+    assert ledger_start == prev_args["step"] * 4, prev_args
+
+
+# -- the divergence sentinel --------------------------------------------------
+
+def test_sentinel_nan_window_heals_bit_identical(tmp_path):
+    """Acceptance drill 1 (pytest twin of the chaos_drill leg): poisoned
+    window -> trip, rollback, quarantine, resume past it; final losses
+    bit-identical to a twin that never saw the poisoned records."""
+    poison = set(range(16, 24))  # steps 4-5 at batch 4: one fused chunk
+    d_p = str(tmp_path / "poison")
+    paths = _write_shards(d_p, 40, poison=poison)
+    d_c = str(tmp_path / "clean")
+    os.makedirs(d_c)
+    clean, idx = [], 0
+    for p in paths:
+        q = os.path.join(d_c, os.path.basename(p))
+        with open(q, "w") as f:
+            for line in open(p):
+                if idx not in poison:
+                    f.write(line)
+                idx += 1
+        clean.append(q)
+    qfile = str(tmp_path / "quarantine.jsonl")
+    sent = DivergenceSentinel(nan=True, max_trips=2)
+    healed = _supervised(str(tmp_path / "ck_h"),
+                         _reader(paths, quarantine_path=qfile),
+                         sentinel=sent)
+    assert healed.steps_done == 8 and healed.rollbacks == 1
+    assert [t.rule for t in healed.trips] == ["nan"]
+    assert healed.records_quarantined == 8
+    rows = [json.loads(ln) for ln in open(qfile)]
+    assert sorted(r["id"] for r in rows) == \
+        sorted("shard_%d.txt#%d" % (i // 20, i % 20) for i in poison)
+    twin = _supervised(str(tmp_path / "ck_t"), _reader(clean))
+    assert [_bits(r[0]) for r in healed.losses] == \
+        [_bits(r[0]) for r in twin.losses]
+
+
+def test_sentinel_spike_rule_and_budget(tmp_path):
+    sent = DivergenceSentinel(nan=False, spike_z=3.0, spike_window=16,
+                              spike_min_history=4, max_trips=2)
+    hist = [1.0, 1.01, 0.99, 1.0, 1.02, 0.98]
+    trip = sent.check_rows([[np.float32(50.0)]], hist)
+    assert trip is not None and trip.rule == "spike"
+    assert sent.check_rows([[np.float32(1.0)]], hist) is None
+    # budget: trips at DISTINCT steps beyond max_trips -> fatal
+    sent.register_trip(4, trip)
+    t2 = sent.check_rows([[np.float32(60.0)]], hist)
+    sent.register_trip(8, t2)
+    t3 = sent.check_rows([[np.float32(70.0)]], hist)
+    with pytest.raises(SentinelFatal, match="budget exhausted"):
+        sent.register_trip(12, t3)
+
+
+def test_sentinel_repeat_trip_same_step_fatal():
+    sent = DivergenceSentinel(max_trips=10)
+    t1 = sent.check_rows([[np.float32(np.nan)]], [])
+    assert t1 is not None and t1.rule == "nan"
+    sent.register_trip(6, t1)
+    t2 = sent.check_rows([[np.float32(np.nan)]], [])
+    with pytest.raises(SentinelFatal, match="REPEAT trip at step 6"):
+        sent.register_trip(6, t2)
+
+
+def test_sentinel_watchdog_exception_names_op(monkeypatch, tmp_path):
+    """With CHECK_NUMERICS=2 the guarded step raises the typed watchdog
+    error; the sentinel maps it to a nan trip CARRYING the <slot>:<type>
+    op name, and a repeat trip surfaces it in the SentinelFatal."""
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NUMERICS", "2")
+    poison = set(range(8, 16))  # steps 2-3
+    paths = _write_shards(str(tmp_path / "shards"), 40, poison=poison)
+    sent = DivergenceSentinel(nan=True, max_trips=3)
+    healed = _supervised(str(tmp_path / "ck"),
+                         _reader(paths, quarantine_path=str(
+                             tmp_path / "q.jsonl")),
+                         sentinel=sent)
+    assert healed.steps_done == 8 and healed.rollbacks == 1
+    trip = healed.trips[0]
+    assert trip.rule == "nan" and trip.named_op is not None
+    assert re.match(r"\d+:\w+", trip.named_op), trip.named_op
+
+
+def test_sentinel_rollback_flight_recorded(monkeypatch, tmp_path):
+    monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path / "flight"))
+    poison = set(range(16, 24))
+    paths = _write_shards(str(tmp_path / "shards"), 40, poison=poison)
+    sent = DivergenceSentinel(nan=True, max_trips=2)
+    _supervised(str(tmp_path / "ck"), _reader(paths), sentinel=sent)
+    # the trip event is in the ring; force a dump through a fatal twin:
+    # replaying the SAME poisoned stream WITHOUT quarantine support would
+    # be contrived — instead assert the ring recorded the trip by dumping
+    from paddle_tpu.monitor import device as dev
+
+    fr = dev.flight_recorder()
+    assert fr is not None
+    path = fr.dump("test", None)
+    doc = json.load(open(path))
+    events = [e for e in doc["entries"] if e.get("event") == "sentinel_trip"]
+    assert events and events[0]["rolled_back_to"] == 4
+    assert events[0]["quarantined"] == 8
+
+
+# -- jittered backoff satellite ----------------------------------------------
+
+def test_backoff_schedule_seeded_jitter_reproducible():
+    a = backoff_schedule(0.1, 4, seed=7)
+    b = backoff_schedule(0.1, 4, seed=7)
+    assert a == b, "same seed must reproduce the same schedule"
+    c = backoff_schedule(0.1, 4, seed=8)
+    assert a != c, "seed must actually vary the jitter"
+    # exponential envelope with jitter in [0.5, 1.0) of the pure schedule
+    for i, s in enumerate(a):
+        pure = 0.1 * (2 ** i)
+        assert 0.5 * pure <= s < pure
+    # the supervisor derives its seed from the active fault plan
+    plan = FaultPlan([], seed=7)
+    with plan:
+        assert faults.current_plan().seed == 7

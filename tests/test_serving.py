@@ -272,3 +272,53 @@ def test_eos_stops_generation(rng):
     eng.run()
     assert req.state == "finished"
     assert len(req.tokens_out) == 1 and req.tokens_out[0] == toks[0]
+
+
+def test_graceful_drain_finishes_inflight_rejects_new(rng):
+    """ISSUE 10 satellite: drain stops admitting (typed DrainingError on
+    submit, queued requests shed REJECTED), finishes the in-flight
+    requests, reclaims every page and closes the engine."""
+    eng = serving.ServingEngine(get_model(), small_config(slots=2))
+    reqs = [eng.submit(list(rng.randint(0, 64, 8)), 4) for _ in range(4)]
+    eng.step()  # admit two into slots, two remain queued
+    assert eng.scheduler.occupancy == 2 and eng.scheduler.queue_depth == 2
+    eng.request_drain()
+    with pytest.raises(serving.DrainingError):
+        eng.submit([1, 2, 3], 2)
+    summary = eng.drain(timeout_s=30.0)
+    assert summary == {"finished": 2, "timed_out": 0, "failed": 0,
+                       "rejected": 2}, summary
+    states = sorted(r.state for r in reqs)
+    assert states == ["finished", "finished", "rejected", "rejected"]
+    assert eng.pool.num_used == 0 and eng.page_accounting_ok()
+    assert eng._closed and eng.last_drain == summary
+    # rejected requests never held slots or pages
+    for r in reqs:
+        if r.state == "rejected":
+            assert not r.pages and r.slot is None
+
+
+def test_drain_timeout_cuts_stragglers_loose(rng):
+    """A drain past its budget retires the stragglers TIMEOUT — pages come
+    back, the engine still closes (never hangs a rollout)."""
+    eng = serving.ServingEngine(get_model(), small_config(slots=2))
+    r1 = eng.submit(list(rng.randint(0, 64, 8)), 8)
+    eng.step()
+    assert r1.state == "running"
+    summary = eng.drain(timeout_s=0.0)  # budget already spent
+    assert summary["timed_out"] == 1 and r1.state == "timeout"
+    assert not r1.pages and eng.pool.num_used == 0
+    assert eng._closed
+
+
+def test_drain_interrupts_run_loop(rng):
+    """request_drain mid-run (the SIGTERM handler's path): the drive loop
+    flips into drain at the next cycle instead of tearing down."""
+    eng = serving.ServingEngine(get_model(), small_config(slots=2))
+    reqs = [eng.submit(list(rng.randint(0, 64, 8)), 6) for _ in range(2)]
+    eng.step()
+    eng.request_drain()
+    eng.run(max_steps=100)
+    assert eng.last_drain is not None and eng.last_drain["finished"] == 2
+    assert all(r.state == "finished" for r in reqs)
+    assert eng._closed

@@ -334,9 +334,60 @@ def selftest() -> int:
     assert snap["reliability/faults_injected"]["value"] == 1
     for name in ("reliability/preemptions", "reliability/retries",
                  "reliability/checkpoints_written", "reliability/resumes",
+                 "reliability/feed_errors",
                  "serving/faults", "serving/retries", "serving/timeouts",
-                 "serving/requests_failed"):
+                 "serving/requests_failed", "serving/drains",
+                 "serving/drained_requests", "serving/drain_rejected"):
         assert name in snap, "missing instrument %s" % name
+    metrics.reset()
+
+    # 6b. data/* + sentinel/* registries: the ingestion pipeline's counters
+    #     must feed the registry from a real (tiny) reader pass — one good
+    #     record, one corrupt, one quarantine-skip on the second epoch —
+    #     and loading the sentinel registers its trip/rollback instruments
+    #     (the full self-heal/exactly-once recovery drills have their own
+    #     gate, tools/chaos_drill --selftest)
+    import numpy as np
+
+    from paddle_tpu import data as pdata
+    from paddle_tpu.reliability import sentinel as _sentinel  # noqa: F401
+
+    metrics.reset()
+    with tempfile.TemporaryDirectory() as td:
+        shard = os.path.join(td, "rows.txt")
+        with open(shard, "w") as f:
+            f.write("1.0 2.0\nbad record\n3.0 4.0\n")
+        qfile = os.path.join(td, "quarantine.jsonl")
+
+        def parse(line):
+            vals = [float(t) for t in line.split()]
+            return {"x": np.asarray(vals, np.float32)}
+
+        reader = pdata.CheckpointableReader(
+            [shard], parse, batch_size=2,
+            schema=[pdata.FieldSpec("x", (2,), np.float32)],
+            epochs=2, quarantine_path=qfile,
+            max_corrupt_rate=0.9, corrupt_check_min=1)
+        batches = list(reader)
+        assert len(batches) == 2 and batches[0]["x"].shape == (2, 2)
+        qrows = [json.loads(ln) for ln in open(qfile)]
+        assert len(qrows) == 1 and qrows[0]["id"] == "rows.txt#1", qrows
+        assert "parse" in qrows[0]["reason"]
+        snap = metrics.snapshot()
+        assert snap["data/records_read"]["value"] == 4
+        assert snap["data/records_corrupt"]["value"] == 1
+        assert snap["data/records_quarantined"]["value"] == 1
+        assert snap["data/records_skipped"]["value"] == 1  # epoch-2 skip
+        assert snap["data/batches"]["value"] == 2
+        assert snap["data/epochs_completed"]["value"] == 2
+        assert snap["data/bytes_read"]["value"] > 0
+        for name in ("data/prefetch_depth", "data/prefetch_wait_ms",
+                     "sentinel/trips", "sentinel/rollbacks",
+                     "sentinel/records_quarantined", "sentinel/lr_backoffs",
+                     "sentinel/fatals", "sentinel/trips_nan",
+                     "sentinel/trips_spike", "sentinel/trips_plateau",
+                     "sentinel/trips_grad_norm"):
+            assert name in snap, "missing instrument %s" % name
     metrics.reset()
 
     # 7. continuous telemetry: JSONL ring write/rotate/read-back, interval

@@ -51,6 +51,10 @@ MODULES = [
     "paddle_tpu.reliability",
     "paddle_tpu.reliability.faults",
     "paddle_tpu.reliability.supervisor",
+    "paddle_tpu.reliability.sentinel",
+    "paddle_tpu.data",
+    "paddle_tpu.data.reader",
+    "paddle_tpu.data.multislot",
     "paddle_tpu.tune",
     "paddle_tpu.tune.table",
     "paddle_tpu.tune.search",

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import sys
 import time
 
@@ -29,6 +30,24 @@ if _REPO not in sys.path:
 import numpy as np  # noqa: E402
 
 from paddle_tpu.monitor.metrics import sorted_percentile  # noqa: E402
+
+# the engine currently being driven by drive() — what the SIGTERM handler
+# drains instead of letting the process die mid-decode
+_live_engine = [None]
+
+
+def _install_sigterm_drain() -> None:
+    """Bench-mode graceful shutdown: SIGTERM requests a drain on the live
+    engine (finish in-flight, shed queued, close) instead of killing the
+    process mid-decode; drive() prints the drain summary and exits 0."""
+
+    def handler(signum, frame):
+        eng = _live_engine[0]
+        if eng is None:
+            raise SystemExit(143)
+        eng.request_drain()  # run() performs the drain at the next cycle
+
+    signal.signal(signal.SIGTERM, handler)
 
 
 def make_stream(n_requests, vocab, max_prompt, max_new_hi, seed=0,
@@ -54,12 +73,28 @@ def drive(model, stream, scfg, warmup=True, keep_open=False):
     from paddle_tpu import serving
 
     eng = serving.ServingEngine(model, scfg)
+    _live_engine[0] = eng
     if warmup:
         eng.warmup()
     t0 = time.perf_counter()
-    reqs = [eng.submit(p, m) for p, m in stream]
+    reqs = []
+    try:
+        for p, m in stream:
+            reqs.append(eng.submit(p, m))
+    except serving.DrainingError:
+        pass  # SIGTERM between legs: serve what was accepted, then drain
     done = eng.run()
     wall = time.perf_counter() - t0
+    _live_engine[0] = None
+    if eng._draining and eng.last_drain is None:
+        eng.drain()  # drain requested while idle: produce summary + close
+    if eng.last_drain is not None:
+        # a SIGTERM drained us mid-bench: report what was served and leave
+        # cleanly (the engine already closed itself)
+        print(json.dumps({"drained": eng.last_drain,
+                          "served": len([r for r in reqs
+                                         if r.state == "finished"])}))
+        raise SystemExit(0)
     if not keep_open:
         eng.close()
     assert len(done) == len(reqs), "stream did not drain: %d/%d" % (
@@ -260,6 +295,42 @@ def selftest() -> int:
     assert failed_req.state == "failed", failed_req
     assert eng.page_accounting_ok() and eng.pool.num_used == 0
     eng.close()
+    # graceful drain through the REAL signal path: SIGTERM flips the live
+    # engine into drain mode — in-flight requests finish, queued ones are
+    # shed with the typed terminal, new submissions reject typed, the
+    # engine closes. (mid-decode teardown is exactly what this replaces)
+    eng4 = serving.ServingEngine(model, serving.ServingConfig(
+        slots=2, page_size=8, max_seq=64))
+    d_reqs = [eng4.submit(list(rng.randint(0, 64, 6)), 4) for _ in range(4)]
+    eng4.step()  # admit 2 into slots; 2 stay queued
+    _live_engine[0] = eng4
+    prev = signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    try:
+        _install_sigterm_drain()
+        os.kill(os.getpid(), signal.SIGTERM)  # handled: requests the drain
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+        _live_engine[0] = None
+    try:
+        eng4.submit([1, 2, 3], 4)
+        raise AssertionError("draining engine accepted a submission")
+    except serving.DrainingError:
+        pass
+    eng4.run(max_steps=100)  # performs the drain at the cycle boundary
+    summary = eng4.last_drain
+    assert summary is not None, "SIGTERM did not trigger a drain"
+    assert summary["finished"] == 2 and summary["rejected"] == 2, summary
+    states = sorted(r.state for r in d_reqs)
+    assert states == ["finished", "finished", "rejected", "rejected"], states
+    # drained-to-completion requests must leave complete span sets too
+    # (REJECTED ones never reach a validated terminal; they are skipped)
+    all_reqs.extend(r for r in d_reqs if r.state == "finished")
+    assert eng4.pool.num_used == 0 and eng4.page_accounting_ok()
+    assert eng4._closed, "drain did not close the engine"
+    snap = mx.snapshot()
+    assert snap["serving/drains"]["value"] >= 1
+    assert snap["serving/drained_requests"]["value"] >= 2
+    assert snap["serving/drain_rejected"]["value"] >= 3  # 2 shed + 1 typed
     # span-set validation over every terminal request of the drill, plus
     # the written Chrome trace itself (the artifact a human opens)
     spans = tracer.stop_tracing()
@@ -285,6 +356,7 @@ def main(argv=None) -> int:
         return 0
     if argv and argv[0] == "--selftest":
         return selftest()
+    _install_sigterm_drain()  # bench mode: SIGTERM drains, never mid-decode
     kw = {}
     it = iter(argv)
     for a in it:
