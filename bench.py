@@ -1287,6 +1287,20 @@ def bench_scaling(axes_str="data=8"):
     return out
 
 
+def _run_ledger_section(kind, configs, extra=None):
+    """Append one provenance-stamped record to the run ledger (armed via
+    PADDLE_TPU_RUN_LEDGER — see monitor.runlog) and return the tail keys
+    (run_id, ledger path) every summary carries so ledger, telemetry ring
+    and trace artifacts cross-link on one id. Must never sink the bench."""
+    try:
+        from paddle_tpu.monitor import runlog
+
+        runlog.record_run(kind, configs, extra=extra)
+        return runlog.tail_info()
+    except Exception as e:
+        return {"run_id": None, "run_ledger_error": repr(e)[:80]}
+
+
 def main():
     # --pipeline: drive the transformer/ResNet/BERT benches with the fused
     # async run_steps driver (fetch_every=8) instead of run()-per-step; the
@@ -1295,6 +1309,19 @@ def main():
     pipeline = "--pipeline" in sys.argv
     if pipeline:
         sys.argv.remove("--pipeline")
+    if len(sys.argv) > 1 and sys.argv[1] == "--quick":
+        # ~1s CPU probe through tools/perf_gate's tiny MLP train loop:
+        # the cheap way to grow the run ledger a baseline point per
+        # commit; same summary-tail shape as the full bench.
+        from tools import perf_gate as _pg
+
+        configs, breakdowns = _pg.run_probe()
+        summary = dict(configs)
+        summary["autotune"] = _autotune_summary()
+        summary.update(_run_ledger_section("bench", configs,
+                                           extra={"stepstats": breakdowns}))
+        print(json.dumps({"summary": summary}))
+        return 0
     if len(sys.argv) > 1 and sys.argv[1] == "--serve":
         # serving-stack leg (paddle_tpu.serving): ragged continuous batching
         # + paged KV-cache vs the padded static-batch baseline on one
@@ -1326,8 +1353,12 @@ def main():
         for key in ("trace_file", "telemetry_dir"):
             if key in res:
                 serve_summary[key] = res[key]
-        print(json.dumps({"summary": {"serve": serve_summary,
-                                      "autotune": _autotune_summary()}}))
+        tail = {"serve": serve_summary, "autotune": _autotune_summary()}
+        tail.update(_run_ledger_section(
+            "serve_bench", {"serve_mixed_stream": {
+                k: v for k, v in serve_summary.items()
+                if isinstance(v, (int, float))}}))
+        print(json.dumps({"summary": tail}))
         return 0
 
     if len(sys.argv) > 1 and sys.argv[1] == "--mesh":
@@ -1598,6 +1629,12 @@ def main():
     # when the full detail JSON above is truncated (VERDICT "do this" #5)
     summary = _compact_summary(detail)
     summary["autotune"] = _autotune_summary()
+    # run-ledger record + run_id cross-link key, last so a truncated log
+    # still says which ledger record this tail corresponds to
+    summary.update(_run_ledger_section(
+        "bench", {cfg: row for cfg, row in summary.items()
+                  if isinstance(row, dict) and "error" not in row
+                  and cfg != "autotune"}))
     print(json.dumps({"summary": summary}))
     return 0
 

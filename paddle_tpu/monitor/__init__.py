@@ -35,6 +35,11 @@ TPU-native in three pieces:
 * :mod:`~paddle_tpu.monitor.budgets` — checked-in closed-form
   collective-traffic budgets asserted against the measured
   ``collectives/*`` counters (``tools/check_budgets.py``).
+* :mod:`~paddle_tpu.monitor.runlog` / :mod:`~paddle_tpu.monitor.regress`
+  / :mod:`~paddle_tpu.monitor.stepstats` — the ACROSS-run layer: a
+  provenance-stamped run ledger (``PADDLE_TPU_RUN_LEDGER``), noise-aware
+  regression verdicts over its trailing baselines, and step-time
+  bottleneck attribution (``tools/perf_gate.py`` is the CLI).
 
 Quick tour::
 
@@ -51,7 +56,10 @@ from __future__ import annotations
 
 import os
 
-from . import budgets, device, metrics, slo, telemetry, tracer  # noqa: F401
+from . import (  # noqa: F401
+    budgets, device, metrics, regress, runlog, slo, stepstats, telemetry,
+    tracer,
+)
 from .metrics import (  # noqa: F401
     counter, gauge, histogram, enabled, enable, disable,
     snapshot, to_json, to_text, to_prometheus, reset,
@@ -61,7 +69,8 @@ from .step_logger import StepLogger  # noqa: F401
 from .telemetry import TelemetryExporter  # noqa: F401
 
 __all__ = [
-    "budgets", "device", "metrics", "slo", "telemetry", "tracer",
+    "budgets", "device", "metrics", "regress", "runlog", "slo", "stepstats",
+    "telemetry", "tracer",
     "StepLogger", "SLO", "SLOMonitor", "TelemetryExporter",
     "counter", "gauge", "histogram", "enabled", "enable", "disable",
     "snapshot", "to_json", "to_text", "to_prometheus", "reset",

@@ -545,7 +545,10 @@ class FlightRecorder:
                 del self._entries[:len(self._entries) - self.capacity]
 
     def dump(self, reason: str, exc: Optional[BaseException] = None) -> str:
-        """Write the ring + final metrics snapshot; returns the path."""
+        """Write the ring + final metrics snapshot; returns the path.
+        The dump embeds the process ``run_id`` and the most recent
+        telemetry interval delta so it is self-contained AND joinable
+        against the run ledger and the telemetry JSONL ring."""
         os.makedirs(self.dir, exist_ok=True)
         with self._lock:
             self._dumped += 1
@@ -555,6 +558,7 @@ class FlightRecorder:
                 "reason": reason,
                 "t": time.time(),
                 "pid": os.getpid(),
+                "run_id": None,
                 "exception": (None if exc is None
                               else "%s: %s" % (type(exc).__name__, exc)),
                 "env": {k: v for k, v in os.environ.items()
@@ -562,6 +566,23 @@ class FlightRecorder:
                 "entries": list(self._entries),
                 "metrics_final": _mx.snapshot(),
             }
+        try:
+            from .runlog import run_id
+
+            doc["run_id"] = run_id()
+        except Exception:
+            pass
+        try:
+            from .telemetry import active_exporter
+
+            exp = active_exporter()
+            last = exp.last_sample if exp is not None else None
+            if last is not None:
+                doc["telemetry_last"] = {
+                    "seq": last.seq, "t": last.t, "dt_s": last.dt_s,
+                    "deltas": last.deltas}
+        except Exception:
+            pass
         with open(path, "w") as f:
             json.dump(doc, f, indent=1, default=str)
         return path

@@ -255,6 +255,7 @@ class Histogram(_Instrument):
         if n:
             out["p50"] = self.percentile(50)
             out["p95"] = self.percentile(95)
+            out["p99"] = self.percentile(99)
         return out
 
     def reset(self) -> None:
@@ -325,9 +326,11 @@ def to_text() -> str:
         t = snap["type"]
         if t == "histogram":
             lines.append(
-                "%-40s hist  count=%d mean=%.3f p50=%.3f p95=%.3f min=%.3f max=%.3f"
+                "%-40s hist  count=%d mean=%.3f p50=%.3f p95=%.3f p99=%.3f "
+                "min=%.3f max=%.3f"
                 % (name, snap["count"], snap["mean"], snap.get("p50", 0.0),
-                   snap.get("p95", 0.0), snap["min"], snap["max"]))
+                   snap.get("p95", 0.0), snap.get("p99", 0.0),
+                   snap["min"], snap["max"]))
         else:
             lines.append("%-40s %-5s value=%g" % (name, t, snap["value"]))
     return "\n".join(lines)

@@ -1,0 +1,241 @@
+"""Noise-aware perf regression detection over the run ledger.
+
+Benchmarks are noisy; a naive ``current < previous`` gate either cries
+wolf on every CPU-jitter wobble or needs thresholds so loose a real 10%
+regression slides through. This module compares a run against a TRAILING
+BASELINE WINDOW per (config, metric) using robust statistics:
+
+* baseline center = **median**, spread = **MAD** (median absolute
+  deviation) — one outlier run cannot move either;
+* the noise band is ``max(rel_threshold, mad_mult * 1.4826 * MAD /
+  |median|)`` — at least the configured relative tolerance, widened when
+  the baseline itself is noisy (1.4826 scales MAD to a normal sigma);
+* **direction-aware**: throughput metrics (eps/QPS/MFU/tokens-per-sec)
+  regress DOWNWARD, latency/step-time metrics (p99/ms) regress UPWARD —
+  inferred from the metric name, overridable per call;
+* **min-sample gating**: a deviation beyond the band is only called
+  REGRESSED/IMPROVED with ``min_samples`` baseline runs to stand on;
+  fewer yields INSUFFICIENT_DATA (a verdict, not a guess). Within-band
+  runs are NEUTRAL against any non-empty baseline; under ``min_samples``
+  the band floor widens by ``sqrt(min_samples/n)`` since the MAD has
+  nothing to say yet.
+
+Verdicts are typed (:class:`Verdict`); :func:`report` gives each a
+one-line human rendering. :func:`check_verdicts` is the enforcement arm
+(SLO-monitor pattern): every REGRESSED verdict ticks ``perf/regressions``,
+records a flight-recorder event when ``PADDLE_TPU_FLIGHT_DIR`` is armed,
+and invokes an optional degrade hook. ``tools/perf_gate.py --check``
+turns the result into a CI exit code.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from . import metrics as _mx
+
+__all__ = [
+    "REGRESSED", "IMPROVED", "NEUTRAL", "INSUFFICIENT_DATA",
+    "Verdict", "metric_direction", "compare_point", "compare_run",
+    "baseline_series", "check_verdicts", "report",
+]
+
+REGRESSED = "REGRESSED"
+IMPROVED = "IMPROVED"
+NEUTRAL = "NEUTRAL"
+INSUFFICIENT_DATA = "INSUFFICIENT_DATA"
+
+_c_regressions = _mx.counter(
+    "perf/regressions", help="REGRESSED verdicts raised by the regression "
+                             "detector (monitor.regress)")
+_c_comparisons = _mx.counter(
+    "perf/comparisons", help="(config, metric) comparisons evaluated")
+
+# MAD -> sigma for normally distributed noise
+_MAD_SIGMA = 1.4826
+
+# name fragments decide which way "worse" points; checked lower-better
+# first so "latency_p50_ms" never reads as throughput
+_LOWER_BETTER = ("latency", "_ms", "ms_", "p99", "p95", "p50", "step_time",
+                 "wall", "overhead", "wait", "stall", "ttft")
+_HIGHER_BETTER = ("eps", "examples_per_sec", "steps_per_sec", "qps", "mfu",
+                  "tokens_per_sec", "throughput", "efficiency", "speedup",
+                  "ratio")
+
+
+def metric_direction(name: str) -> int:
+    """+1 = higher is better, -1 = lower is better, 0 = unknown (the
+    detector skips metrics it cannot orient rather than guessing)."""
+    low = name.lower()
+    if low.endswith("ms") or any(t in low for t in _LOWER_BETTER):
+        return -1
+    if any(t in low for t in _HIGHER_BETTER):
+        return 1
+    return 0
+
+
+def _median(xs: Sequence[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def _mad(xs: Sequence[float], center: float) -> float:
+    return _median([abs(x - center) for x in xs]) if xs else 0.0
+
+
+class Verdict:
+    """One (config, metric) comparison outcome."""
+
+    __slots__ = ("config", "metric", "verdict", "current", "baseline_median",
+                 "baseline_mad", "n_baseline", "direction", "delta_frac",
+                 "band_frac")
+
+    def __init__(self, config: str, metric: str, verdict: str,
+                 current: Optional[float] = None,
+                 baseline_median: Optional[float] = None,
+                 baseline_mad: float = 0.0, n_baseline: int = 0,
+                 direction: int = 0, delta_frac: Optional[float] = None,
+                 band_frac: Optional[float] = None):
+        self.config = config
+        self.metric = metric
+        self.verdict = verdict
+        self.current = current
+        self.baseline_median = baseline_median
+        self.baseline_mad = baseline_mad
+        self.n_baseline = n_baseline
+        self.direction = direction
+        self.delta_frac = delta_frac
+        self.band_frac = band_frac
+
+    def to_doc(self) -> dict:
+        return {s: getattr(self, s) for s in self.__slots__}
+
+    def describe(self) -> str:
+        if self.baseline_median is None:
+            return "%-12s %s/%s: no baseline" % (
+                self.verdict, self.config, self.metric)
+        return ("%-12s %s/%s: %.4g vs median %.4g (n=%d, %+.1f%%, "
+                "band ±%.1f%%, %s better)" % (
+                    self.verdict, self.config, self.metric,
+                    self.current, self.baseline_median, self.n_baseline,
+                    100.0 * (self.delta_frac or 0.0),
+                    100.0 * (self.band_frac or 0.0),
+                    "higher" if self.direction > 0 else "lower"))
+
+
+def compare_point(config: str, metric: str, current: float,
+                  baseline: Sequence[float], *, direction: Optional[int] = None,
+                  rel_threshold: float = 0.10, mad_mult: float = 4.0,
+                  min_samples: int = 4) -> Optional[Verdict]:
+    """Verdict for one value against its trailing baseline series; None
+    when the metric's direction is unknown (nothing to enforce)."""
+    d = metric_direction(metric) if direction is None else direction
+    if d == 0:
+        return None
+    _c_comparisons.inc()
+    vals = [float(v) for v in baseline]
+    if not vals:
+        return Verdict(config, metric, INSUFFICIENT_DATA, current=current,
+                       direction=d)
+    med = _median(vals)
+    mad = _mad(vals, med)
+    if med == 0.0:
+        # a zero-centered baseline has no meaningful relative band
+        return Verdict(config, metric, INSUFFICIENT_DATA, current=current,
+                       baseline_median=med, baseline_mad=mad,
+                       n_baseline=len(vals), direction=d)
+    band = max(rel_threshold, mad_mult * _MAD_SIGMA * mad / abs(med))
+    if len(vals) < min_samples:
+        # under min_samples the MAD is untrustworthy (n=1 gives MAD=0),
+        # so the floor widens by sqrt(min_samples/n): less baseline,
+        # less certainty, wider NEUTRAL zone. Beyond it the verdict is
+        # INSUFFICIENT_DATA anyway, never REGRESSED.
+        band = max(band, rel_threshold * (min_samples / len(vals)) ** 0.5)
+    delta = (current - med) / abs(med)
+    # positive badness = movement in the "worse" direction
+    badness = -delta if d > 0 else delta
+    if abs(delta) <= band:
+        v = NEUTRAL
+    elif len(vals) < min_samples:
+        v = INSUFFICIENT_DATA  # out of band, but too few runs to call it
+    elif badness > 0:
+        v = REGRESSED
+    else:
+        v = IMPROVED
+    return Verdict(config, metric, v, current=current, baseline_median=med,
+                   baseline_mad=mad, n_baseline=len(vals), direction=d,
+                   delta_frac=delta, band_frac=band)
+
+
+def baseline_series(history: Sequence[dict], config: str, metric: str,
+                    window: int = 20) -> List[float]:
+    """Trailing numeric values of (config, metric) across ledger records,
+    oldest->newest, capped at ``window``."""
+    out: List[float] = []
+    for rec in history:
+        v = (rec.get("configs") or {}).get(config, {}).get(metric)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out.append(float(v))
+    return out[-window:]
+
+
+def compare_run(record: dict, history: Sequence[dict], *,
+                rel_threshold: float = 0.10, mad_mult: float = 4.0,
+                min_samples: int = 4, window: int = 20,
+                directions: Optional[Dict[str, int]] = None
+                ) -> List[Verdict]:
+    """Compare every numeric (config, metric) of ``record`` against its
+    trailing window in ``history`` (earlier ledger records, any order —
+    ledger order is append order). ``directions`` overrides the
+    name-inferred orientation per metric name."""
+    verdicts: List[Verdict] = []
+    for config, metrics in sorted((record.get("configs") or {}).items()):
+        if not isinstance(metrics, dict):
+            continue
+        for metric, value in sorted(metrics.items()):
+            if not isinstance(value, (int, float)) or isinstance(value, bool):
+                continue
+            base = baseline_series(history, config, metric, window=window)
+            v = compare_point(
+                config, metric, float(value), base,
+                direction=(directions or {}).get(metric),
+                rel_threshold=rel_threshold, mad_mult=mad_mult,
+                min_samples=min_samples)
+            if v is not None:
+                verdicts.append(v)
+    return verdicts
+
+
+def check_verdicts(verdicts: Sequence[Verdict],
+                   on_regression: Optional[Callable[[Verdict], None]] = None
+                   ) -> List[Verdict]:
+    """Enforcement: tick ``perf/regressions`` per REGRESSED verdict,
+    record a flight-recorder event (when armed), fire the degrade hook.
+    Returns the regressed subset (empty = gate passes)."""
+    regressed = [v for v in verdicts if v.verdict == REGRESSED]
+    for v in regressed:
+        _c_regressions.inc()
+        try:
+            from .device import flight_recorder
+
+            fr = flight_recorder()
+            if fr is not None:
+                fr.record_event("perf_regression", **v.to_doc())
+        except Exception:
+            pass
+        if on_regression is not None:
+            try:
+                on_regression(v)
+            except Exception:
+                pass
+    return regressed
+
+
+def report(verdicts: Sequence[Verdict]) -> str:
+    """Human rendering, worst first."""
+    order = {REGRESSED: 0, INSUFFICIENT_DATA: 1, IMPROVED: 2, NEUTRAL: 3}
+    return "\n".join(v.describe() for v in
+                     sorted(verdicts, key=lambda v: (order.get(v.verdict, 9),
+                                                     v.config, v.metric)))

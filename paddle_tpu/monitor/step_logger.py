@@ -119,6 +119,7 @@ class StepLogger:
                 "mean": round(sum(ts) / len(ts), 3),
                 "p50": round(_metrics.sorted_percentile(ts, 50), 3),
                 "p95": round(_metrics.sorted_percentile(ts, 95), 3),
+                "p99": round(_metrics.sorted_percentile(ts, 99), 3),
                 "max": round(ts[-1], 3),
             }
         if self._last_loss is None and self._pending_loss is not None:

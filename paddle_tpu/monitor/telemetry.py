@@ -226,6 +226,9 @@ class TelemetryExporter:
         self._seq = 0
         self._file_idx = 0
         self._samples_in_file = 0
+        # most recent tick, for consumers that need the latest interval
+        # delta without re-ticking (flight-recorder dumps join on it)
+        self.last_sample: Optional[TelemetrySample] = None
         snap = _mx.snapshot()
         self._prev_counters = _counter_values(snap)
         self._prev_hists = _hist_state(snap)
@@ -299,6 +302,7 @@ class TelemetryExporter:
             self._prev_hists = hists
             self._prev_gauges = gauges
             self._last_t = now
+            self.last_sample = sample
             self._write(sample)
             listeners = list(self._listeners)
         _c_samples.inc()

@@ -110,17 +110,19 @@ class StepProfiler:
             raise ValueError("sorted_key must be one of %s, got %r"
                              % (sorted(keys), sorted_key))
         rows = sorted(self._records.items(), key=keys[sorted_key])
-        lines = ["%-24s %8s %12s %12s %12s %12s %12s %12s" % (
+        lines = ["%-24s %8s %12s %12s %12s %12s %12s %12s %12s" % (
             "Event", "Calls", "Total(ms)", "Min(ms)", "Max(ms)", "Ave(ms)",
-            "P50(ms)", "P95(ms)")]
+            "P50(ms)", "P95(ms)", "P99(ms)")]
         from .monitor.metrics import sorted_percentile
 
         for name, ts in rows:
             st = sorted(ts)
-            lines.append("%-24s %8d %12.3f %12.3f %12.3f %12.3f %12.3f %12.3f" % (
-                name, len(ts), sum(ts) * 1e3, min(ts) * 1e3, max(ts) * 1e3,
-                sum(ts) / len(ts) * 1e3, sorted_percentile(st, 50) * 1e3,
-                sorted_percentile(st, 95) * 1e3))
+            lines.append(
+                "%-24s %8d %12.3f %12.3f %12.3f %12.3f %12.3f %12.3f %12.3f" % (
+                    name, len(ts), sum(ts) * 1e3, min(ts) * 1e3, max(ts) * 1e3,
+                    sum(ts) / len(ts) * 1e3, sorted_percentile(st, 50) * 1e3,
+                    sorted_percentile(st, 95) * 1e3,
+                    sorted_percentile(st, 99) * 1e3))
         lines.append("(kernel-level drill-down: run under profiler()/"
                      "start_profiler and open the trace dir in TensorBoard)")
         return "\n".join(lines)

@@ -302,12 +302,32 @@ def main(argv=None) -> int:
     for name, shape, e in failures:
         print("SWEEP FAILED %s %r: %s: %s"
               % (name, shape, type(e).__name__, e), file=sys.stderr)
-    # machine tail: the sweep digest as one JSON line (bench-style)
-    print(json.dumps({
+    # machine tail: the sweep digest as one JSON line (bench-style),
+    # carrying the run_id (+ ledger record when PADDLE_TPU_RUN_LEDGER is
+    # armed) so tuned-table provenance joins the perf trend data
+    tail = {
         "autotune": [r.to_dict() for r in results],
         "failures": ["%s %r: %r" % (n, s, str(e)[:120])
                      for n, s, e in failures],
-    }, default=str))
+    }
+    try:
+        from paddle_tpu.monitor import runlog
+
+        configs = {}
+        for r in results:
+            row = {}
+            if r.best_ms is not None:
+                row["best_ms"] = r.best_ms
+            if r.speedup_vs_default is not None:
+                row["speedup_vs_default"] = r.speedup_vs_default
+            if row:
+                configs["%s/%s" % (r.kernel, r.bucket)] = row
+        runlog.record_run("autotune", configs,
+                          extra={"n_failures": len(failures)})
+        tail.update(runlog.tail_info())
+    except Exception as e:
+        tail["run_ledger_error"] = repr(e)[:80]
+    print(json.dumps(tail, default=str))
     return 1 if failures and not results else 0
 
 

@@ -50,9 +50,11 @@ def format_snapshot(snap: dict) -> str:
         s = snap[name]
         t = s.get("type", "?")
         if t == "histogram":
-            detail = ("count=%d mean=%.3f p50=%.3f p95=%.3f min=%.3f max=%.3f"
+            detail = ("count=%d mean=%.3f p50=%.3f p95=%.3f p99=%.3f "
+                      "min=%.3f max=%.3f"
                       % (s.get("count", 0), s.get("mean", 0.0),
                          s.get("p50", 0.0), s.get("p95", 0.0),
+                         s.get("p99", 0.0),
                          s.get("min", 0.0), s.get("max", 0.0)))
         else:
             v = s.get("value", 0)
@@ -181,7 +183,8 @@ def selftest() -> int:
     assert snap["selftest/count"]["value"] == 3
     assert snap["selftest/hist"]["count"] == 3
     assert "p95" in snap["selftest/hist"]
-    format_snapshot(snap)  # must not raise
+    assert "p99" in snap["selftest/hist"]
+    assert "p99=" in format_snapshot(snap)  # table carries the P99 column
     # disabled = inert
     metrics.disable()
     c.inc(100)

@@ -33,7 +33,10 @@ MODULES = [
     "paddle_tpu.monitor.budgets",
     "paddle_tpu.monitor.device",
     "paddle_tpu.monitor.metrics",
+    "paddle_tpu.monitor.regress",
+    "paddle_tpu.monitor.runlog",
     "paddle_tpu.monitor.slo",
+    "paddle_tpu.monitor.stepstats",
     "paddle_tpu.monitor.telemetry",
     "paddle_tpu.monitor.tracer",
     "paddle_tpu.nets",

@@ -365,7 +365,24 @@ def main(argv=None) -> int:
             print("unknown flag %r" % a, file=sys.stderr)
             return 2
         kw["n_requests" if key == "requests" else key] = int(next(it))
-    print(json.dumps(serve_bench(**kw), indent=1))
+    res = serve_bench(**kw)
+    try:
+        # one run-ledger record per serve bench (armed via
+        # PADDLE_TPU_RUN_LEDGER); the run_id rides the printed JSON so
+        # ledger <-> telemetry <-> trace artifacts join on it
+        from paddle_tpu.monitor import runlog
+
+        configs = {}
+        for leg in ("continuous_paged", "static_padded"):
+            if isinstance(res.get(leg), dict) and "error" not in res[leg]:
+                configs["serve_" + leg] = {
+                    k: v for k, v in res[leg].items()
+                    if isinstance(v, (int, float))}
+        runlog.record_run("serve_bench", configs)
+        res.update(runlog.tail_info())
+    except Exception as e:
+        res["run_ledger_error"] = repr(e)[:80]
+    print(json.dumps(res, indent=1))
     return 0
 
 
