@@ -1347,7 +1347,22 @@ def main():
             "qps_ratio_vs_padded": res["qps_ratio_vs_padded"],
             "decode_fuse": "%s(%s)" % (res["config"]["decode_fuse"],
                                        res["config"]["decode_fuse_source"]),
+            # which decode-attention inner loop the headline leg ran +
+            # the tune-table layer that supplied its block config
+            "decode_kernel": "%s(%s)" % (cont["decode_kernel"],
+                                         cont["decode_kernel_source"]),
         }
+        # the paged-kernel A/B leg (present when the kernel compiled, i.e.
+        # --kernel paged or auto-on-TPU): kernel:gather ratios + the
+        # kernel leg's own provenance ride the tail
+        kleg = res.get("continuous_paged_kernel")
+        if isinstance(kleg, dict) and "error" not in kleg:
+            serve_summary["kernel_qps_ratio"] = (
+                res["kernel_vs_gather"]["qps_ratio"])
+            serve_summary["kernel_tokens_per_sec_ratio"] = (
+                res["kernel_vs_gather"]["tokens_per_sec_ratio"])
+            serve_summary["kernel_leg"] = "%s(%s)" % (
+                kleg["decode_kernel"], kleg["decode_kernel_source"])
         # observability artifacts (armed via PADDLE_TPU_TRACE_FILE /
         # PADDLE_TPU_TELEMETRY_DIR) surface in the truncation-proof tail
         for key in ("trace_file", "telemetry_dir"):

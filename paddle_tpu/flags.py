@@ -52,6 +52,16 @@ _DEFAULTS: Dict[str, Any] = {
                                      # off-TPU); "interpret" = force the
                                      # interpreter (parity tests); "off" =
                                      # always scatter
+    "paged_attention_kernel": "auto", # ragged paged-attention Pallas decode
+                                     # kernel (pallas_kernels/
+                                     # paged_attention.py) instead of the XLA
+                                     # page-gather + decode_attention in the
+                                     # serving decode scan: "auto" = compiled
+                                     # kernel on TPU, gather elsewhere;
+                                     # "on" = kernel everywhere (interpreted
+                                     # off-TPU); "interpret" = force the
+                                     # interpreter (parity tests); "off" =
+                                     # always gather
     "ctr_alltoall_update": False,    # sharded-table sparse updates route
                                      # (ids, rows) to owner shards with an
                                      # explicit lax.all_to_all (PS split_ids

@@ -134,8 +134,10 @@ def decode_forward(params: Dict, cfg: DecoderConfig, cache, cache_ops,
 
     ``tokens``/``pos``/``active`` are [B]; the token at ``pos[b]`` has its
     K/V written into the cache (inactive slots dropped inside the scatter)
-    BEFORE attention over the gathered context masked to ``pos+1`` valid
-    positions. Returns (logits [B,V], cache') — the cache pytree threads
+    BEFORE attention over the context masked to ``pos+1`` valid positions —
+    dispatched through ``cache_ops.decode_attention``, so the layout owns
+    the gather-vs-fused-Pallas-kernel choice and this loop stays
+    layout-blind. Returns (logits [B,V], cache') — the cache pytree threads
     functionally so the engine's fused scan carries it on device.
     """
     b = tokens.shape[0]
@@ -147,9 +149,8 @@ def decode_forward(params: Dict, cfg: DecoderConfig, cache, cache_ops,
         k = (h @ lp["wk"]).reshape(b, cfg.n_head, cfg.d_head)
         v = (h @ lp["wv"]).reshape(b, cfg.n_head, cfg.d_head)
         cache = cache_ops.write_token(cache, i, k, v, pos, active)
-        ctx_k, ctx_v = cache_ops.context(cache, i)
-        o = attention_ops.decode_attention(q, ctx_k, ctx_v, pos + 1,
-                                           sm_scale=cfg.sm_scale)
+        o = cache_ops.decode_attention(cache, i, q, pos + 1,
+                                       sm_scale=cfg.sm_scale)
         x = x + o.reshape(b, cfg.d_model) @ lp["wo"]
         x = x + _ffn(_ln(x, lp["ln2_g"], lp["ln2_b"]), lp)
     x = _ln(x, params["lnf_g"], params["lnf_b"])

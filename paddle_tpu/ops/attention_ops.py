@@ -39,6 +39,47 @@ def _on_tpu() -> bool:
     return jax.default_backend() not in ("cpu", "gpu")
 
 
+def neg_inf(dtype) -> jnp.ndarray:
+    """THE masking constant for every attention implementation in this
+    package (composed sdpa, decode_attention, and the paged-attention
+    Pallas kernel's reference check share it, so bf16/f32 masking semantics
+    cannot drift between them). Scaled to the dtype — ``-0.7 * finfo.max``,
+    the same convention as the vendored flash kernel's DEFAULT_MASK_VALUE —
+    so it stays finite in bf16/f16 (a raw ``-1e30`` overflows f16 to -inf
+    and then ``-inf - max`` NaNs the softmax) while ``exp()`` of it still
+    underflows to exactly 0.0: masked positions contribute exactly nothing.
+    """
+    dt = jnp.dtype(dtype)
+    return jnp.asarray(neg_inf_value(dt), dt)
+
+
+def neg_inf_value(dtype) -> float:
+    """:func:`neg_inf` as a host-side Python float — for call sites that
+    bake the constant into a kernel as a static parameter (the paged-
+    attention Pallas kernel), where a traced array would not do."""
+    return -0.7 * float(jnp.finfo(jnp.dtype(dtype)).max)
+
+
+def paged_kernel_mode():
+    """Resolve ``FLAGS_paged_attention_kernel`` for this trace: None = the
+    XLA gather + :func:`decode_attention` path, "compiled"/"interpret" =
+    the ragged paged-attention Pallas kernel
+    (pallas_kernels/paged_attention.py). "auto" compiles on TPU and keeps
+    the gather path elsewhere — the interpreter is a correctness tool, not
+    a fast CPU path (mirrors optimizer_ops._sparse_kernel_mode)."""
+    from ..flags import flags
+
+    mode = str(flags.paged_attention_kernel).lower()
+    if mode in ("0", "off", "false", "no"):
+        return None
+    if mode == "interpret":
+        return "interpret"
+    on_tpu = jax.default_backend() == "tpu"
+    if mode in ("1", "on", "true", "yes"):
+        return "compiled" if on_tpu else "interpret"
+    return "compiled" if on_tpu else None  # auto
+
+
 def _pick_block(s: int):
     """Largest v5e-tuned tile (512 optimal, r4 sweep) that divides ``s``.
     Single source of truth for both sdpa and ring-attention block compute."""
@@ -247,11 +288,11 @@ def sdpa(q, k, v, bias=None, segment_ids_q=None, segment_ids_kv=None,
         scores = scores + bias
     if segment_ids_q is not None:
         mask = segment_ids_q[:, None, :, None] == segment_ids_kv[:, None, None, :]
-        scores = jnp.where(mask, scores, jnp.full_like(scores, -1e9))
+        scores = jnp.where(mask, scores, neg_inf(scores.dtype))
     if causal:
         sq, sk = scores.shape[-2], scores.shape[-1]
         cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        scores = jnp.where(cm, scores, jnp.full_like(scores, -1e9))
+        scores = jnp.where(cm, scores, neg_inf(scores.dtype))
     # dtype-preserving softmax by default: every f32-accumulation variant
     # measured COSTS HBM on the Transformer bench (diag_overhead.py, r4) —
     # forcing bf16-probs residuals via custom_vjp +1.9 GB/step, f32-cast
@@ -286,18 +327,20 @@ def decode_attention(q, ctx_k, ctx_v, ctx_len, sm_scale=1.0):
     the SAME math here, which is what makes the two layouts bit-comparable.
     ``ctx_len`` [B] counts the valid leading positions (prompt + generated,
     INCLUDING the current token, whose k/v the caller wrote before calling).
-    Invalid positions are masked with a large-negative constant whose exp
-    underflows to exactly 0.0, so cache garbage beyond ``ctx_len`` (stale
-    rows from a retired request, unreserved pages) contributes exactly
-    nothing — independent of layout. Returns [B,H,D].
+    Invalid positions are masked with :func:`neg_inf` (exp underflows to
+    exactly 0.0), so cache garbage beyond ``ctx_len`` (stale rows from a
+    retired request, unreserved pages) contributes exactly nothing —
+    independent of layout. Returns [B,H,D].
 
     This is the XLA fallback path of the serving stack's ragged paged
-    attention; a Pallas kernel fusing the page gather into the attention
-    inner loop can replace it behind the same signature.
+    attention; pallas_kernels/paged_attention.py fuses the page gather into
+    the attention inner loop behind the same signature contract (armed via
+    ``FLAGS_paged_attention_kernel``, see :func:`paged_kernel_mode` and
+    ``serving.kv_cache.PagedKVCache.decode_attention``).
     """
     scores = jnp.einsum("bhd,blhd->bhl", q, ctx_k) * sm_scale
     mask = jnp.arange(ctx_k.shape[1])[None, None, :] < ctx_len[:, None, None]
-    scores = jnp.where(mask, scores, jnp.asarray(-1e30, scores.dtype))
+    scores = jnp.where(mask, scores, neg_inf(scores.dtype))
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhl,blhd->bhd", probs, ctx_v)
 

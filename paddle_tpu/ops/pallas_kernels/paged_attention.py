@@ -1,0 +1,362 @@
+"""Ragged paged-attention Pallas TPU kernel for the serving decode loop.
+
+Motivation (ROADMAP item 1, "Ragged Paged Attention" in PAPERS.md): the
+serving engine's hottest inner loop — one decode attention per layer per
+fused step — runs as an XLA gather that materializes every slot's KV
+context ``[B, max_ctx, H, D]`` in HBM (serving.kv_cache.PagedKVCache
+.context) before ops.attention_ops.decode_attention reduces it. That
+traffic is ``B * max_ctx * H * D`` elements per layer per step regardless
+of how short the ragged sequences actually are. This kernel fuses the page
+gather into the attention inner loop: K/V pages stream from the flat page
+pool ``[num_pages*page_size, H, D]`` straight into VMEM scratch via
+per-page DMAs driven by the device-resident page table, and an
+online-softmax accumulator reduces them wave by wave — HBM traffic becomes
+``sum_b ctx_len[b] * H * D`` (only the LIVE rows move) and the ``[B,
+max_ctx, H, D]`` intermediate never exists.
+
+Design (the sparse_adam batched-DMA pattern applied to attention):
+
+- grid is ``(slots,)``; the page table (flattened) and per-slot ``ctx_len``
+  ride in SMEM via ``PrefetchScalarGridSpec`` scalar prefetch, so page
+  addresses are known before the body runs;
+- per slot, pages stream in waves of ``block_pages`` (the autotunable
+  knob, table kernel key ``paged_attention``): each wave starts
+  ``2 * block_pages`` row-range DMAs back-to-back (K and V per page), waits
+  once, then folds the wave into the online-softmax state ``(m, l, acc)``
+  carried through the wave loop in registers;
+- the ragged bound: waves whose pages lie entirely at/after ``ctx_len``
+  skip their DMAs (``@pl.when``), and the position mask uses
+  attention_ops.neg_inf — the SAME masking constant as the gather path —
+  so stale rows beyond ``ctx_len`` (retired requests, unreserved pages)
+  contribute exactly 0.0, bit-for-bit like the gather path's mask;
+- page ids from the table are clamped to the pool, so a corrupt table row
+  degrades to wrong-but-safe reads, never an OOB DMA.
+
+``interpret=True`` runs the same kernel through the Pallas interpreter on
+CPU — what tier-1 parity tests and the ``--selftest`` CLI use; the
+compiled path needs a real TPU. The engine arms the kernel via
+``FLAGS_paged_attention_kernel`` (auto = compiled on TPU only; on =
+everywhere, interpreted off-TPU; interpret = force the interpreter; off =
+gather), resolved by attention_ops.paged_kernel_mode and dispatched from
+serving.kv_cache.PagedKVCache.decode_attention.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend (absent on some CPU-only installs)
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+__all__ = [
+    "paged_decode_attention",
+    "gather_reference",
+    "paged_attention_supported",
+]
+
+_VMEM_WAVE_BUDGET = 2 * 1024 * 1024  # K+V scratch bytes one wave may hold
+
+
+def paged_attention_supported(dtype) -> bool:
+    """Gate: pallas-TPU importable and a float cache dtype."""
+    if pltpu is None:
+        return False
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def _default_block_pages(page_size: int, pages_per_slot: int, hd: int,
+                         itemsize: int = 4) -> int:
+    """Largest power-of-two pages-per-wave whose K+V VMEM scratch fits the
+    wave budget — the untuned fallback the autotune sweep measures
+    against."""
+    bp = 1
+    while (bp * 2 <= pages_per_slot
+           and 2 * (bp * 2) * page_size * hd * itemsize
+           <= _VMEM_WAVE_BUDGET):
+        bp *= 2
+    return bp
+
+
+def _block_pages(block, page_size: int, pages_per_slot: int, max_ctx: int,
+                 hd: int, itemsize: int = 4) -> int:
+    """Pages per DMA wave. ``block=None`` (the entry point's default)
+    consults the tuned config table (paddle_tpu.tune: kernel
+    ``paged_attention``, bucketed by (max_ctx, H*D) + device_kind, with the
+    shipped v5e seed) and falls back to the analytic VMEM-budget default —
+    an explicit integer is honored verbatim (clamped to the slot's page
+    count), which keeps the autotuner's own sweep from looping through the
+    table it is writing. The lookup never raises; a corrupt table logs once
+    inside tune.table and lands here as the default."""
+    if block is None:
+        block = _default_block_pages(page_size, pages_per_slot, hd, itemsize)
+        try:
+            from ...tune import table as _tt
+
+            cfg, _src = _tt.lookup("paged_attention",
+                                   _tt.bucket_ctx(max_ctx, hd))
+            if cfg and int(cfg.get("block_pages", 0)) > 0:
+                block = int(cfg["block_pages"])
+        except Exception:
+            pass
+    return max(1, min(int(block), pages_per_slot))
+
+
+def _page_dma(table_ref, scr_ref, sem, row, slot_row, ps):
+    """Async copy of one page (``ps`` contiguous [H, D] rows) between the
+    HBM pool and VMEM scratch."""
+    return pltpu.make_async_copy(
+        table_ref.at[pl.ds(row, ps)],
+        scr_ref.at[pl.ds(slot_row, ps)],
+        sem,
+    )
+
+
+def _paged_attn_kernel(pt_ref, len_ref, q_ref, k_hbm, v_hbm, o_ref,
+                       k_scr, v_scr, sems, *, block_pages, page_size,
+                       pages_per_slot, num_pages, n_waves, sm_scale,
+                       mask_value):
+    b = pl.program_id(0)
+    ps = page_size
+    ctx = len_ref[b]
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # [H, D]
+    h, d = q.shape
+    rows = block_pages * ps
+
+    def page_row(i, wave):
+        """Pool row offset of wave-local page ``i`` (clamped: a corrupt
+        table entry reads a wrong page, never out of bounds)."""
+        pidx = jnp.minimum(wave * block_pages + i, pages_per_slot - 1)
+        page = pt_ref[b * pages_per_slot + pidx]
+        return jnp.clip(page, 0, num_pages - 1) * ps
+
+    def page_valid(i, wave):
+        pidx = wave * block_pages + i
+        return (pidx < pages_per_slot) & (pidx * ps < ctx)
+
+    def wave_body(w, carry):
+        m, l, acc = carry
+
+        def start(i, _):
+            @pl.when(page_valid(i, w))
+            def _():
+                row = page_row(i, w)
+                _page_dma(k_hbm, k_scr, sems.at[0, i], row, i * ps, ps).start()
+                _page_dma(v_hbm, v_scr, sems.at[1, i], row, i * ps, ps).start()
+
+            return 0
+
+        jax.lax.fori_loop(0, block_pages, start, 0)
+
+        def wait(i, _):
+            @pl.when(page_valid(i, w))
+            def _():
+                row = page_row(i, w)
+                _page_dma(k_hbm, k_scr, sems.at[0, i], row, i * ps, ps).wait()
+                _page_dma(v_hbm, v_scr, sems.at[1, i], row, i * ps, ps).wait()
+
+            return 0
+
+        jax.lax.fori_loop(0, block_pages, wait, 0)
+
+        # absolute context positions of this wave's scratch rows, and the
+        # ragged validity mask (also covers never-DMA'd pages: their
+        # positions are >= ctx by construction)
+        pos = (w * rows
+               + jax.lax.broadcasted_iota(jnp.int32, (1, rows), 1))  # [1,R]
+        valid = pos < ctx
+        kb = k_scr[...].astype(jnp.float32)  # [R, H, D]
+        # invalid rows hold whatever the scratch last held — zero V so the
+        # exactly-0 probabilities below cannot meet an Inf/NaN residue
+        vb = jnp.where(valid.reshape(-1, 1, 1),
+                       v_scr[...].astype(jnp.float32), 0.0)
+        s = jnp.sum(q[None, :, :] * kb, axis=-1).T  # [H, R]
+        s = jnp.where(valid, s, mask_value)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))  # [H,1]
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)  # masked lanes underflow to exactly 0.0
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jnp.sum(p.T[:, :, None] * vb, axis=0)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((h, 1), mask_value, jnp.float32)
+    l0 = jnp.zeros((h, 1), jnp.float32)
+    acc0 = jnp.zeros((h, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_waves, wave_body, (m0, l0, acc0))
+    # ctx_len >= 1 in the engine (position of the current token + 1); the
+    # clamp only guards a degenerate ctx_len <= 0 call from dividing 0/0
+    out = acc / jnp.maximum(l, jnp.asarray(1e-30, jnp.float32))
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, ctx_len, *,
+                           page_size, sm_scale=1.0, block_pages=None,
+                           interpret: bool = False):
+    """Fused ragged paged decode attention.
+
+    ``q`` [B,H,D] — current position's query per slot. ``k_pages``/
+    ``v_pages`` [num_pages*page_size, H, D] — ONE layer of the paged KV
+    pool (serving.kv_cache.PagedKVCache state). ``page_table`` [B,
+    pages_per_slot] int32 — each slot's ordered page ids. ``ctx_len`` [B] —
+    valid leading positions per slot (must be >= 1 for slots whose output
+    is consumed). ``block_pages=None`` = tuned-table lookup with the
+    analytic VMEM-budget fallback (see ``_block_pages``). Returns [B,H,D],
+    matching ``gather_reference`` (the XLA gather + decode_attention path)
+    to float32 round-off on live rows and EXACTLY ignoring garbage beyond
+    ``ctx_len``.
+    """
+    if pltpu is None:
+        raise RuntimeError(
+            "paged_decode_attention: jax.experimental.pallas.tpu unavailable "
+            "on this install — gate with paged_attention_supported() (the "
+            "XLA gather path is the fallback, "
+            "FLAGS_paged_attention_kernel=off)")
+    b, h, d = q.shape
+    slots, pages_per_slot = page_table.shape
+    if slots != b:
+        raise ValueError("page_table slots %d != q batch %d" % (slots, b))
+    ps = int(page_size)
+    num_rows = k_pages.shape[0]
+    if num_rows % ps != 0:
+        raise ValueError("pool rows %d not a multiple of page_size %d"
+                         % (num_rows, ps))
+    max_ctx = pages_per_slot * ps
+    bp = _block_pages(block_pages, ps, pages_per_slot, max_ctx, h * d,
+                      jnp.dtype(k_pages.dtype).itemsize)
+    n_waves = -(-pages_per_slot // bp)
+    from ..attention_ops import neg_inf_value
+
+    kernel = functools.partial(
+        _paged_attn_kernel, block_pages=bp, page_size=ps,
+        pages_per_slot=pages_per_slot, num_pages=num_rows // ps,
+        n_waves=n_waves, sm_scale=float(sm_scale),
+        mask_value=neg_inf_value(jnp.float32))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda i, *_: (i, 0, 0)),  # q
+            pl.BlockSpec(memory_space=pltpu.ANY),              # K pool
+            pl.BlockSpec(memory_space=pltpu.ANY),              # V pool
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda i, *_: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bp * ps, h, d), k_pages.dtype),
+            pltpu.VMEM((bp * ps, h, d), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, bp)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(page_table.reshape(-1).astype(jnp.int32),
+      ctx_len.astype(jnp.int32), q, k_pages, v_pages)
+
+
+def gather_reference(q, k_pages, v_pages, page_table, ctx_len, page_size,
+                     sm_scale=1.0):
+    """The XLA path the kernel replaces, as a standalone reference: the
+    PagedKVCache.context gather composed with attention_ops
+    .decode_attention (which supplies the SHARED neg_inf masking constant
+    — the parity contract the selftest asserts)."""
+    ps = int(page_size)
+    rows = (page_table * ps)[:, :, None] + jnp.arange(ps)[None, None, :]
+    rows = rows.reshape(page_table.shape[0], -1)
+    from ..attention_ops import decode_attention
+
+    return decode_attention(q, k_pages[rows], v_pages[rows], ctx_len,
+                            sm_scale=sm_scale)
+
+
+# -- selftest -----------------------------------------------------------------
+
+
+def _selftest() -> int:
+    """CPU interpret-mode parity vs the XLA gather path at mixed ragged
+    lengths, including a garbage-page poisoning leg — the CI smoke next to
+    sparse_adam --selftest (<5 s)."""
+    import time
+
+    t0 = time.time()
+    rng = np.random.RandomState(0)
+    slots, h, d, ps, pages_per_slot = 5, 2, 16, 8, 8
+    num_pages = 24
+    max_ctx = pages_per_slot * ps
+    sm = 1.0 / float(d) ** 0.5
+
+    # a shared pool with slots owning disjoint page sets, deliberately
+    # scrambled so logical order != pool order
+    perm = rng.permutation(num_pages)
+    pt = np.zeros((slots, pages_per_slot), np.int32)
+    for s_i in range(slots):
+        pt[s_i] = np.resize(perm[s_i::slots], pages_per_slot)
+    # ragged mixed lengths: 1 token, mid-page, page-exact, multi-page, full
+    ctx_len = np.array([1, 7, 8, 33, max_ctx], np.int32)
+
+    k_pool = rng.randn(num_pages * ps, h, d).astype(np.float32)
+    v_pool = rng.randn(num_pages * ps, h, d).astype(np.float32)
+    q = rng.randn(slots, h, d).astype(np.float32)
+
+    def run(kp, vp, block):
+        got = paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(pt), jnp.asarray(ctx_len), page_size=ps,
+            sm_scale=sm, block_pages=block, interpret=True)
+        want = gather_reference(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(pt), jnp.asarray(ctx_len), ps, sm_scale=sm)
+        return np.asarray(got), np.asarray(want)
+
+    # clean pool, several wave widths (incl. a non-divisor and the tuned
+    # default path)
+    for block in (1, 3, 4, None):
+        got, want = run(k_pool, v_pool, block)
+        np.testing.assert_allclose(
+            got, want, rtol=1e-6, atol=1e-6,
+            err_msg="kernel vs gather mismatch at block_pages=%s" % block)
+
+    # garbage-page poisoning: every pool row NOT covered by a slot's valid
+    # prefix gets huge finite garbage (stale retired-request rows). Both
+    # paths must be bit-unmoved: their masks zero those contributions
+    # exactly. (NaN poisoning is out of contract: the gather path's
+    # 0 * NaN would already break.)
+    live = np.zeros(num_pages * ps, bool)
+    for s_i in range(slots):
+        n = int(ctx_len[s_i])
+        flat = (pt[s_i].repeat(ps) * ps
+                + np.tile(np.arange(ps), pages_per_slot))[:n]
+        live[flat] = True
+    k_poison = k_pool.copy()
+    v_poison = v_pool.copy()
+    k_poison[~live] = 1e4 * rng.randn((~live).sum(), h, d)
+    v_poison[~live] = -1e4 * np.ones(((~live).sum(), h, d), np.float32)
+    got_p, want_p = run(k_poison, v_poison, 2)
+    np.testing.assert_allclose(got_p, want_p, rtol=1e-6, atol=1e-6,
+                               err_msg="poisoned kernel vs gather mismatch")
+    clean, _ = run(k_pool, v_pool, 2)
+    np.testing.assert_array_equal(
+        got_p, clean,
+        err_msg="garbage beyond ctx_len leaked into the kernel output")
+
+    print("paged_attention selftest OK (%.2fs): kernel == gather on %d "
+          "ragged slots (ctx %s), garbage pages contribute exactly zero"
+          % (time.time() - t0, slots, list(map(int, ctx_len))))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--selftest" in sys.argv:
+        sys.exit(_selftest())
+    print("usage: python -m paddle_tpu.ops.pallas_kernels.paged_attention "
+          "--selftest")
+    sys.exit(2)

@@ -54,6 +54,43 @@ def _pow2_buckets(lo: int, hi: int) -> tuple:
     return tuple(sorted(set(out)))
 
 
+def _sample_tokens(logits, temp, top_k, seed, position):
+    """Device-side per-slot token selection, shared by the prefill
+    executable and the fused decode scan.
+
+    ``logits`` [B,V]; ``temp``/``top_k``/``seed``/``position`` [B].
+    ``temp[b] == 0`` returns EXACTLY ``argmax(logits[b])`` — the greedy
+    path's own computation, selected by ``where``, so greedy requests are
+    bit-identical whether or not sampling requests share the batch.
+    ``temp[b] > 0`` draws via the Gumbel-argmax trick over the
+    temperature-scaled logits, restricted to the ``top_k[b]`` largest when
+    positive (threshold at the k-th sorted logit; ties below it are kept,
+    matching the usual top-k convention of "never a logit SMALLER than the
+    k-th"). The draw is keyed ``fold_in(PRNGKey(seed[b]), position[b])`` —
+    a pure function of the request's own seed and the absolute context
+    position of the token being consumed, so the stream is reproducible
+    across ``decode_fuse`` widths and a slot re-admitted to a new request
+    (new seed) can never replay the previous tenant's draws."""
+    from ..ops.attention_ops import neg_inf
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    v = logits.shape[-1]
+    scaled = logits.astype(jnp.float32) / jnp.maximum(
+        temp.astype(jnp.float32), 1e-6)[:, None]
+    k = jnp.clip(jnp.where(top_k > 0, top_k, v), 1, v)
+    srt = jax.lax.sort(scaled, dimension=-1)[:, ::-1]  # descending
+    kth = jnp.take_along_axis(srt, (k - 1)[:, None], axis=-1)
+    masked = jnp.where(scaled >= kth, scaled, neg_inf(jnp.float32))
+
+    def draw(seed_b, pos_b):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed_b), pos_b)
+        return jax.random.gumbel(key, (v,), jnp.float32)
+
+    sampled = jnp.argmax(masked + jax.vmap(draw)(seed, position),
+                         axis=-1).astype(jnp.int32)
+    return jnp.where(temp > 0, sampled, greedy)
+
+
 class ServingConfig:
     """Engine geometry + policy knobs.
 
@@ -182,6 +219,11 @@ class ServingEngine:
         self._active = jnp.zeros((b,), jnp.bool_)
         self._gen = jnp.zeros((b,), jnp.int32)
         self._maxnew = jnp.ones((b,), jnp.int32)
+        # per-slot sampling params (ride the decode dispatch as plain
+        # arguments; 0-temperature slots run the exact greedy path)
+        self._temp = jnp.zeros((b,), jnp.float32)
+        self._topk = jnp.zeros((b,), jnp.int32)
+        self._seed = jnp.zeros((b,), jnp.int32)
         self._prefill_exe: Dict[int, Any] = {}   # bucket -> AOT executable
         self._decode_exe: Dict[int, Any] = {}    # fuse length -> executable
         self._captured_logits: Dict[int, List[np.ndarray]] = {}
@@ -245,19 +287,24 @@ class ServingEngine:
         self._slo_breach = None
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               temperature: float = 0.0, top_k: int = 0,
+               seed: Optional[int] = None) -> Request:
         """Queue a request. Raises ``ValueError`` for a request that can
         NEVER be served at this geometry, and ``BackpressureError`` when
         the bounded queue is full (shed/retry — transient). ``deadline_s``
         bounds the request's wall-clock life from submission: past it the
         request is retired with TIMEOUT status (queued or running) so it
-        stops pinning a slot and KV pages."""
+        stops pinning a slot and KV pages. ``temperature``/``top_k``/
+        ``seed`` select device-side sampled decoding for THIS request (see
+        :class:`~.request.Request`); the default is exact greedy."""
         if self._draining:
             _sm.DRAIN_REJECTED.inc()
             raise DrainingError(
                 "engine is draining (graceful shutdown): not admitting new "
                 "requests — re-route to a peer")
-        req = Request(prompt, max_new_tokens, deadline_s=deadline_s)
+        req = Request(prompt, max_new_tokens, deadline_s=deadline_s,
+                      temperature=temperature, top_k=top_k, seed=seed)
         if req.prompt_len > self.cfg.prompt_buckets[-1]:
             raise ValueError(
                 "prompt length %d exceeds the largest prefill bucket %d"
@@ -361,7 +408,34 @@ class ServingEngine:
         """Per-emitted-token logits rows (``collect_logits=True`` only)."""
         return self._captured_logits.get(req.id, [])
 
+    def decode_kernel_info(self) -> tuple:
+        """``(kernel, source)`` of the decode-attention inner loop as THIS
+        engine resolves it: ``("paged", <tuned|shipped|default>)`` when the
+        ragged paged-attention Pallas kernel is armed
+        (``FLAGS_paged_attention_kernel``, paged layout) — source is the
+        tune-table layer answering its ``block_pages`` lookup, i.e. the
+        provenance the compiled trace saw — else ``("gather", "n/a")``."""
+        from ..ops import attention_ops
+
+        if self.cfg.paged and attention_ops.paged_kernel_mode() is not None:
+            from ..ops.pallas_kernels import paged_attention as _pa
+
+            if _pa.paged_attention_supported(self.cache_ops.dtype):
+                mcfg = self.model.cfg
+                try:
+                    from .. import tune
+
+                    _c, src = tune.lookup(
+                        "paged_attention",
+                        tune.bucket_ctx(self.cfg.max_seq,
+                                        mcfg.n_head * mcfg.d_head))
+                except Exception:
+                    src = "default"
+                return "paged", src
+        return "gather", "n/a"
+
     def stats(self) -> dict:
+        kern, kern_src = self.decode_kernel_info()
         out = {
             "layout": self.cache_ops.layout,
             "queued": self.scheduler.queue_depth,
@@ -370,6 +444,8 @@ class ServingEngine:
             "decode_fuse": self.cfg.decode_fuse,
             "decode_fuse_source": getattr(self.cfg, "decode_fuse_source",
                                           "explicit"),
+            "decode_kernel": kern,
+            "decode_kernel_source": kern_src,
         }
         if self.pool is not None:
             out["pages_in_use"] = self.pool.num_used
@@ -480,7 +556,10 @@ class ServingEngine:
         t0 = time.perf_counter()
         self._cache, first_tok, last_logits = exe(
             self.params, self._cache, dest, jnp.asarray(prompt),
-            jnp.asarray(req.prompt_len, jnp.int32))
+            jnp.asarray(req.prompt_len, jnp.int32),
+            jnp.asarray(req.temperature, jnp.float32),
+            jnp.asarray(req.top_k, jnp.int32),
+            jnp.asarray(req.seed, jnp.int32))
         tok = int(np.asarray(first_tok))
         t1 = time.perf_counter()
         _trace.on_prefill(req, slot, bucket, t0, t1)
@@ -502,6 +581,9 @@ class ServingEngine:
         self._active = self._active.at[slot].set(True)
         self._gen = self._gen.at[slot].set(1)
         self._maxnew = self._maxnew.at[slot].set(req.max_new_tokens)
+        self._temp = self._temp.at[slot].set(req.temperature)
+        self._topk = self._topk.at[slot].set(req.top_k)
+        self._seed = self._seed.at[slot].set(req.seed)
         return None
 
     # -- decode ---------------------------------------------------------------
@@ -546,7 +628,8 @@ class ServingEngine:
                     raise PagePoolExhausted(
                         "injected pool exhaustion at serving.decode")
                 out = exe(self.params, self._cache, self._len, self._tok,
-                          self._active, self._gen, self._maxnew)
+                          self._active, self._gen, self._maxnew,
+                          self._temp, self._topk, self._seed)
                 if self.cfg.collect_logits:
                     (self._cache, self._len, self._tok, self._active,
                      self._gen, toks, emitted, fin, logseq) = out
@@ -669,6 +752,9 @@ class ServingEngine:
         self._active = jnp.zeros((b,), jnp.bool_)
         self._gen = jnp.zeros((b,), jnp.int32)
         self._maxnew = jnp.ones((b,), jnp.int32)
+        self._temp = jnp.zeros((b,), jnp.float32)
+        self._topk = jnp.zeros((b,), jnp.int32)
+        self._seed = jnp.zeros((b,), jnp.int32)
         if self._cache_lost():
             self._cache = self.cache_ops.init_state()
         return failed
@@ -687,11 +773,14 @@ class ServingEngine:
                          "generated": len(req.tokens_out),
                          "max_new_tokens": req.max_new_tokens,
                          "pages": list(req.pages)})
+        kern, kern_src = self.decode_kernel_info()
         return {"layout": self.cache_ops.layout, "slots": rows,
                 "queue_depth": self.scheduler.queue_depth,
                 "decode_fuse": self.cfg.decode_fuse,
                 "decode_fuse_source": getattr(self.cfg, "decode_fuse_source",
-                                              "explicit")}
+                                              "explicit"),
+                "decode_kernel": kern,
+                "decode_kernel_source": kern_src}
 
     # -- AOT compilation ------------------------------------------------------
     def _get_prefill_exe(self, bucket: int):
@@ -700,12 +789,17 @@ class ServingEngine:
             return exe
         model, ops, cfg = self.model, self.cache_ops, self.cfg
 
-        def prefill(params, cache, dest, prompt, length):
+        def prefill(params, cache, dest, prompt, length, temp, topk, seed):
             logits, kvs = model.prefill(params, prompt[None], length[None])
             for i, (k, v) in enumerate(kvs):
                 cache = ops.write_prompt(cache, i, k[0], v[0], dest, length)
             last = logits[0, length - 1]
-            return cache, jnp.argmax(last).astype(jnp.int32), last
+            # first generated token: same sampler as the decode scan, keyed
+            # by the last PROMPT position (decode steps then key length,
+            # length+1, ... — the streams can't collide)
+            tok = _sample_tokens(last[None], temp[None], topk[None],
+                                 seed[None], (length - 1)[None])[0]
+            return cache, tok, last
 
         dest_abs = (jax.ShapeDtypeStruct((ops.pages_per_slot,), jnp.int32)
                     if cfg.paged else jax.ShapeDtypeStruct((), jnp.int32))
@@ -713,6 +807,9 @@ class ServingEngine:
             prefill,
             (self.params, self._cache, dest_abs,
              jax.ShapeDtypeStruct((bucket,), jnp.int32),
+             jax.ShapeDtypeStruct((), jnp.int32),
+             jax.ShapeDtypeStruct((), jnp.float32),
+             jax.ShapeDtypeStruct((), jnp.int32),
              jax.ShapeDtypeStruct((), jnp.int32)),
             donate_argnums=(1,))
         self._prefill_exe[bucket] = exe
@@ -727,11 +824,15 @@ class ServingEngine:
         max_ctx = cfg.max_seq
         collect = cfg.collect_logits
 
-        def chunk(params, cache, lengths, tokens, active, gen, maxnew):
+        def chunk(params, cache, lengths, tokens, active, gen, maxnew,
+                  temp, topk, seed):
             def body(carry, _):
                 cache, ln, tk, ac, gc = carry
                 logits, cache = model.decode(params, cache, ops, tk, ln, ac)
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                # device-side sampling: keyed by ln (the consumed token's
+                # absolute position), which advances per STEP not per
+                # dispatch — fuse=1 and fuse=4 draw identical streams
+                nxt = _sample_tokens(logits, temp, topk, seed, ln)
                 nxt = jnp.where(ac, nxt, tk)
                 emitted = ac
                 gc = gc + ac
@@ -750,7 +851,7 @@ class ServingEngine:
         exe = aot_compile(
             chunk,
             (self.params, self._cache, self._len, self._tok, self._active,
-             self._gen, self._maxnew),
+             self._gen, self._maxnew, self._temp, self._topk, self._seed),
             donate_argnums=(1,))
         self._decode_exe[fuse] = exe
         return exe

@@ -1,16 +1,18 @@
 """KV-cache layouts for the decode driver: paged block-pool vs contiguous.
 
 Two layouts behind ONE functional interface (`init_state` / `write_token` /
-`write_prompt` / `context`), so the model's decode loop is layout-blind and
-the two paths are bit-comparable:
+`write_prompt` / `context` / `decode_attention`), so the model's decode
+loop is layout-blind and the two paths are bit-comparable:
 
 * :class:`PagedKVCache` — the "Ragged Paged Attention" layout (PAPERS.md):
   KV rows live in a flat page pool ``[n_layer, num_pages*page_size, H, D]``
   and each slot owns an ordered page table ``[slots, pages_per_slot]``.
   Ragged sequence lengths cost only their pages; ``context`` gathers a
-  slot's pages back into logical order (the XLA-gather fallback the issue
-  requires; a Pallas kernel can later fuse the gather into the attention
-  inner loop behind the same interface).
+  slot's pages back into logical order (the XLA-gather path), and
+  ``decode_attention`` dispatches between that gather and the fused
+  ragged paged-attention Pallas kernel
+  (ops/pallas_kernels/paged_attention.py) per
+  ``FLAGS_paged_attention_kernel``.
 * :class:`ContiguousKVCache` — the dense reference ``[n_layer, slots,
   max_ctx, H, D]`` every slot pays ``max_ctx`` for. The parity yardstick
   (tests/test_serving.py asserts bit-identical tokens/logits) and the
@@ -105,6 +107,31 @@ class PagedKVCache(_KVCacheBase):
         rows = rows.reshape(pt.shape[0], self.max_ctx)
         return state["k"][layer][rows], state["v"][layer][rows]
 
+    def decode_attention(self, state: Cache, layer: int, q, ctx_len,
+                         sm_scale: float = 1.0) -> jnp.ndarray:
+        """One decode-attention step [B,H,D] over this layer's ragged
+        contexts. With ``FLAGS_paged_attention_kernel`` armed (see
+        ops.attention_ops.paged_kernel_mode) the Pallas kernel reads K/V
+        pages straight from the pool via the device-resident page table —
+        the ``[B, max_ctx, H, D]`` gather never materializes; otherwise the
+        XLA gather + ops.attention_ops.decode_attention fallback runs.
+        Both mask positions >= ctx_len with the SAME neg_inf constant, so
+        the paths agree to float round-off (tier-1 parity tests pin it)."""
+        from ..ops import attention_ops
+
+        mode = attention_ops.paged_kernel_mode()
+        if mode is not None:
+            from ..ops.pallas_kernels import paged_attention as _pa
+
+            if _pa.paged_attention_supported(self.dtype):
+                return _pa.paged_decode_attention(
+                    q, state["k"][layer], state["v"][layer], state["pt"],
+                    ctx_len, page_size=self.page_size, sm_scale=sm_scale,
+                    interpret=(mode == "interpret"))
+        ctx_k, ctx_v = self.context(state, layer)
+        return attention_ops.decode_attention(q, ctx_k, ctx_v, ctx_len,
+                                              sm_scale=sm_scale)
+
     # -- prefill (one sequence) ----------------------------------------------
     def prompt_dest(self, pages) -> np.ndarray:
         """Host-side: the ``dest`` operand for ``write_prompt`` — a full
@@ -150,6 +177,16 @@ class ContiguousKVCache(_KVCacheBase):
 
     def context(self, state: Cache, layer: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
         return state["k"][layer], state["v"][layer]
+
+    def decode_attention(self, state: Cache, layer: int, q, ctx_len,
+                         sm_scale: float = 1.0) -> jnp.ndarray:
+        """Dense layout has no gather to fuse away — always the XLA path
+        (the parity yardstick the paged kernel is measured against)."""
+        from ..ops import attention_ops
+
+        ctx_k, ctx_v = self.context(state, layer)
+        return attention_ops.decode_attention(q, ctx_k, ctx_v, ctx_len,
+                                              sm_scale=sm_scale)
 
     def prompt_dest(self, slot: int) -> np.int32:
         return np.int32(slot)

@@ -51,20 +51,37 @@ class Request:
     request past its deadline is retired with state :data:`TIMEOUT` so it
     stops pinning a slot and KV pages. ``error`` carries the failure text
     when a decode failure retires the request as :data:`FAILED`.
+
+    Sampling (device-side, inside the fused decode scan):
+    ``temperature=0`` (the default) is EXACTLY the greedy argmax path —
+    bit-identical tokens, not merely close; ``temperature>0`` samples from
+    the temperature-scaled distribution, restricted to the ``top_k``
+    highest logits when ``top_k>0`` (0 = no restriction). ``seed`` names
+    the request's private RNG stream (derived from the request id when
+    None, so two requests never share one by accident); the stream is
+    keyed by absolute context position, which makes replays reproducible
+    across ``decode_fuse`` widths and slot re-admissions.
     """
 
     __slots__ = ("id", "prompt", "max_new_tokens", "state", "slot", "pages",
                  "tokens_out", "submitted_t", "admitted_t", "first_token_t",
-                 "finished_t", "deadline_s", "error", "trace_id")
+                 "finished_t", "deadline_s", "error", "trace_id",
+                 "temperature", "top_k", "seed")
 
     def __init__(self, prompt: Sequence[int], max_new_tokens: int,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 seed: Optional[int] = None):
         if len(prompt) == 0:
             raise ValueError("Request needs a non-empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if deadline_s is not None and deadline_s < 0:
             raise ValueError("deadline_s must be >= 0")
+        if temperature < 0:
+            raise ValueError("temperature must be >= 0 (0 = greedy)")
+        if top_k < 0:
+            raise ValueError("top_k must be >= 0 (0 = unrestricted)")
         self.id = next(_ids)
         # The per-request trace identity: spans in the serving timeline and
         # flight-recorder batch specs carry it, so a crash dump links back
@@ -82,6 +99,11 @@ class Request:
         self.finished_t: Optional[float] = None
         self.deadline_s = None if deadline_s is None else float(deadline_s)
         self.error: Optional[str] = None
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        # id-derived default: distinct per request, stable for replay when
+        # the caller pins one explicitly
+        self.seed = int(self.id if seed is None else seed) & 0x7FFFFFFF
 
     @property
     def prompt_len(self) -> int:

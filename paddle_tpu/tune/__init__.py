@@ -24,6 +24,7 @@ the softmax-xent tile choice consult :func:`lookup` at trace time;
 """
 
 from .table import (  # noqa: F401
+    bucket_ctx,
     bucket_nv,
     bucket_rows,
     bucket_seq,
@@ -42,7 +43,7 @@ from .table import (  # noqa: F401
 from .search import SearchResult, median_time_ms, search  # noqa: F401
 
 __all__ = [
-    "bucket_nv", "bucket_rows", "bucket_seq", "bucket_slots",
+    "bucket_ctx", "bucket_nv", "bucket_rows", "bucket_seq", "bucket_slots",
     "device_kind", "normalize_device_kind", "pow2_floor",
     "lookup", "record", "table_path", "shipped_path",
     "resolve_decode_fuse",

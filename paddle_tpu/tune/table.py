@@ -47,6 +47,7 @@ __all__ = [
     "FORMAT", "WILDCARD_BUCKET",
     "device_kind", "normalize_device_kind",
     "pow2_floor", "bucket_seq", "bucket_rows", "bucket_nv", "bucket_slots",
+    "bucket_ctx",
     "table_path", "shipped_path", "entry_key",
     "lookup", "record", "read_entries", "write_entries",
     "resolve_decode_fuse",
@@ -145,6 +146,12 @@ def bucket_nv(n: int, v: int) -> str:
 def bucket_slots(slots: int) -> str:
     """Serving-knob bucket over the decode batch width."""
     return "slots%d" % pow2_floor(slots)
+
+
+def bucket_ctx(max_ctx: int, hd: int) -> str:
+    """Paged-attention bucket over (slot context capacity, H*D row width) —
+    the two shapes that size the kernel's per-wave VMEM scratch."""
+    return "c%dxhd%d" % (pow2_floor(max_ctx), pow2_floor(hd))
 
 
 # -- file locations -----------------------------------------------------------

@@ -18,6 +18,8 @@ Registered here:
 * ``pass_gates`` — per-program ``PADDLE_TPU_PASS_*`` gate selection,
   measured END-TO-END on the optimized clone's step time (a pass that
   costs more than it saves on a given program gets turned off for it);
+* ``paged_attention`` — ``block_pages`` of the ragged paged-attention
+  decode kernel (KV pages DMA'd per online-softmax wave);
 * ``serving.decode_fuse`` — how many serving decode steps fuse into one
   dispatched scan (host dispatch overhead vs admission latency).
 
@@ -315,6 +317,80 @@ class SoftmaxXentTunable(Tunable):
             return sx._call_fwd(plog, plab, bn, bv, interp, 0.0, v)
 
         return fwd, ()
+
+
+# -- paged-attention wave width ----------------------------------------------
+
+
+@register_tunable("paged_attention")
+class PagedAttentionTunable(Tunable):
+    """``block_pages`` of the ragged paged-attention decode kernel: KV
+    pages DMA'd per online-softmax wave. Wider waves amortize DMA issue
+    and rescale cost but grow the K/V VMEM scratch (and waste work on
+    short ragged contexts whose last wave is mostly masked); the engine's
+    trace-time ``_block_pages`` lookup serves whatever this sweep
+    persists."""
+
+    kernel = "paged_attention"
+
+    def default_shapes(self):
+        if _on_tpu():
+            return [dict(slots=8, max_ctx=2048, page_size=16, n_head=8,
+                         d_head=64),
+                    dict(slots=16, max_ctx=1024, page_size=16, n_head=8,
+                         d_head=64)]
+        # interpret-mode mechanism shape: seconds on CPU
+        return [dict(slots=4, max_ctx=64, page_size=8, n_head=2, d_head=16)]
+
+    def bucket(self, shape):
+        return _table.bucket_ctx(shape["max_ctx"],
+                                 shape["n_head"] * shape["d_head"])
+
+    def candidates(self, shape):
+        pps = shape["max_ctx"] // shape["page_size"]
+        out, bp = [], 1
+        while bp <= pps:
+            out.append({"block_pages": bp})
+            bp *= 2
+        return out
+
+    def default_config(self, shape):
+        from ..ops.pallas_kernels.paged_attention import _default_block_pages
+
+        pps = shape["max_ctx"] // shape["page_size"]
+        return {"block_pages": _default_block_pages(
+            shape["page_size"], pps, shape["n_head"] * shape["d_head"])}
+
+    def cost(self, shape, config):
+        # the K + V scratch one wave holds resident (f32 worst case)
+        return {"vmem_bytes": 2 * 4 * config["block_pages"]
+                * shape["page_size"] * shape["n_head"] * shape["d_head"]}
+
+    def build(self, shape, config):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..ops.pallas_kernels.paged_attention import paged_decode_attention
+
+        slots, ps = shape["slots"], shape["page_size"]
+        h, d, max_ctx = shape["n_head"], shape["d_head"], shape["max_ctx"]
+        pps = max_ctx // ps
+        num_pages = slots * pps  # full-occupancy pool, like the engine's
+        rng = np.random.RandomState(0)
+        k_pool = jnp.asarray(rng.randn(num_pages * ps, h, d), jnp.float32)
+        v_pool = jnp.asarray(rng.randn(num_pages * ps, h, d), jnp.float32)
+        q = jnp.asarray(rng.randn(slots, h, d), jnp.float32)
+        pt = jnp.asarray(rng.permutation(num_pages)[:slots * pps]
+                         .reshape(slots, pps).astype(np.int32))
+        # the ragged mix the engine actually sees: a spread of live lengths
+        ctx = jnp.asarray(
+            np.linspace(1, max_ctx, slots).round().astype(np.int32))
+        fn = functools.partial(
+            paged_decode_attention, page_size=ps,
+            sm_scale=1.0 / float(d) ** 0.5,
+            block_pages=int(config["block_pages"]),
+            interpret=not _on_tpu())
+        return (lambda: fn(q, k_pool, v_pool, pt, ctx)), ()
 
 
 # -- pass gates (end-to-end measured) ----------------------------------------

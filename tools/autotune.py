@@ -16,11 +16,11 @@
         model directory (io.save_inference_model layout).
 
     python -m tools.autotune --selftest
-        <5s, CPU: table round-trip from a cold dir, determinism of the
+        <10s, CPU: table round-trip from a cold dir, determinism of the
         table produced from a fixed candidate list, corrupt-table
-        fallback, shipped v5e seed lookup, a real (interpret-mode)
-        sparse-adam micro-sweep, and the autotune/* counters. The CI
-        smoke gate (ROADMAP).
+        fallback, shipped v5e seed lookup, real (interpret-mode)
+        sparse-adam + paged-attention micro-sweeps, and the autotune/*
+        counters. The CI smoke gate (ROADMAP).
 
 On CPU the sweeps run the same code path as on TPU (Pallas interpret /
 XLA:CPU timing) — mechanism numbers, not shipping numbers; run the same
@@ -177,6 +177,10 @@ def selftest() -> int:
             cfg, src = tune.lookup("sparse_adam", tune.bucket_rows(4096, 64),
                                    device="tpu-v5e")
             assert src == "shipped" and cfg["block"] == 128, (cfg, src)
+            cfg, src = tune.lookup("paged_attention",
+                                   tune.bucket_ctx(2048, 512),
+                                   device="tpu-v5e")
+            assert src == "shipped" and cfg["block_pages"] == 8, (cfg, src)
             # unknown device -> default (hardcoded fallbacks stay in charge)
             cfg, src = tune.lookup("flash_attention",
                                    tune.bucket_seq(8192, 8192),
@@ -217,6 +221,24 @@ def selftest() -> int:
             got = _block_size(None, shape["n"], shape["dim"])
             assert got == res.best["block"], (got, res.best)
 
+            # 4b. same mechanism for the paged-attention wave width: a
+            #     tiny interpret-mode sweep, then the kernel's trace-time
+            #     _block_pages serves the tuned winner
+            pa = tune.get_tunable("paged_attention")
+            pshape = dict(slots=2, max_ctx=32, page_size=8, n_head=2,
+                          d_head=8)
+            pres = tune.search(pa, pshape,
+                               candidates=[{"block_pages": 1},
+                                           {"block_pages": 2}],
+                               reps=1, warmup=1)
+            assert pres.best["block_pages"] in (1, 2, 4) \
+                and pres.written_path, pres.best
+            from paddle_tpu.ops.pallas_kernels.paged_attention import \
+                _block_pages
+
+            got = _block_pages(None, 8, 4, 32, 16)
+            assert got == pres.best["block_pages"], (got, pres.best)
+
             # 5. corrupt table: logs once, falls back — never raises
             with open(tpath, "w") as f:
                 f.write('{"format": "paddle_tpu.tune/1", "entries": {tor')
@@ -226,6 +248,10 @@ def selftest() -> int:
 
             bs = _tuned_block_sizes(8192, 8192)  # must not raise
             assert bs.block_q == 512  # hardcoded fallback preserved
+            # ...and the paged-attention lookup ladder degrades the same
+            # way: corrupt table -> the analytic VMEM-budget default
+            got = _block_pages(None, 8, 4, 32, 16)
+            assert got == 4, got  # _default_block_pages(8, 4, 16)
 
             # 6. the autotune/* instruments all exist and counted the above
             snap = mx.snapshot()
@@ -236,7 +262,7 @@ def selftest() -> int:
                          "autotune/candidates_failed",
                          "autotune/table_writes", "autotune/table_errors"):
                 assert name in snap, "missing instrument %s" % name
-            assert snap["autotune/sweeps"]["value"] == 3
+            assert snap["autotune/sweeps"]["value"] == 4
             assert snap["autotune/lookup_shipped"]["value"] >= 2
             assert snap["autotune/lookup_tuned"]["value"] >= 2
             assert snap["autotune/candidates_pruned"]["value"] >= 2
@@ -248,10 +274,13 @@ def selftest() -> int:
             else:
                 os.environ["PADDLE_TPU_TUNE_TABLE"] = prev
     dt = time.time() - t0
-    assert dt < 5.0, "selftest too slow: %.1fs" % dt
+    # two interpret-mode kernel micro-sweeps (sparse_adam, paged_attention)
+    # dominate; the Pallas interpreter traces slowly but honestly
+    assert dt < 10.0, "selftest too slow: %.1fs" % dt
     print("autotune selftest: OK (%.1fs): shipped v5e seeds, deterministic "
-          "search, tuned-table round-trip + reroute, corrupt-table "
-          "fallback, autotune/* counters" % dt)
+          "search, tuned-table round-trip + reroute (sparse_adam + "
+          "paged_attention), corrupt-table fallback, autotune/* counters"
+          % dt)
     return 0
 
 

@@ -25,6 +25,7 @@ GATES = (
     ("dump_metrics", "tools.dump_metrics"),
     ("dump_program", "tools.dump_program"),
     ("sparse_adam", "paddle_tpu.ops.pallas_kernels.sparse_adam"),
+    ("paged_attention", "paddle_tpu.ops.pallas_kernels.paged_attention"),
     ("profile_report", "tools.profile_report"),
     ("serve_bench", "tools.serve_bench"),
     ("chaos_drill", "tools.chaos_drill"),

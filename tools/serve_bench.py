@@ -1,15 +1,26 @@
 """Serving-stack bench driver + CI smoke.
 
     python -m tools.serve_bench --selftest
-        <5s, JAX_PLATFORMS=cpu: drives a tiny decoder through
+        <30s, JAX_PLATFORMS=cpu: drives a tiny decoder through
         prefill -> continuous decode -> retire in-process, asserts the
-        scheduler/page-pool invariants and the serving/* counters. The
-        smoke-gate entry (ROADMAP).
+        scheduler/page-pool invariants and the serving/* counters, then
+        runs the bench path end-to-end with the ragged paged-attention
+        kernel armed (interpret mode) and checks kernel provenance plus
+        the run-ledger/perf-gate mechanics. The smoke-gate entry
+        (ROADMAP).
 
     python -m tools.serve_bench [--requests N] [--slots S] [--seed K]
+                                [--kernel {auto,gather,paged}]
         Small synthetic mixed-length serve bench on the current backend:
         ragged continuous batching vs the padded static-batch baseline,
         printed as JSON (p50/p99 latency, sustained QPS, tokens/s).
+        ``--kernel`` selects the decode-attention A/B: the gather legs
+        always run (the baseline the run ledger gates); ``paged`` adds a
+        ``continuous_paged_kernel`` leg with the ragged paged-attention
+        Pallas kernel armed (interpret mode off-TPU — a parity/mechanism
+        leg there, a perf leg on hardware) and reports the kernel:gather
+        QPS + tokens/s ratios; ``auto`` (default) adds that leg only
+        where the kernel compiles (TPU).
 
 ``bench.py --serve`` imports :func:`serve_bench` from here, so the bench
 leg and the smoke share one driver.
@@ -115,6 +126,11 @@ def drive(model, stream, scfg, warmup=True, keep_open=False):
         "ttft_p50_ms": round(sorted_percentile(ttft_ms, 50), 2),
         "ttft_p99_ms": round(sorted_percentile(ttft_ms, 99), 2),
         "cache_bytes": eng.stats()["cache_bytes"],
+        # which decode-attention inner loop THIS leg ran, with the tune-
+        # table layer that supplied its block config (tuned/shipped/
+        # default) — the per-kernel provenance the summary tail carries
+        "decode_kernel": eng.stats()["decode_kernel"],
+        "decode_kernel_source": eng.stats()["decode_kernel_source"],
     }, eng
 
 
@@ -132,15 +148,21 @@ def resolve_decode_fuse(decode_fuse, slots):
 
 def serve_bench(n_requests=64, slots=8, vocab=512, n_layer=4, d_model=128,
                 n_head=4, max_seq=256, page_size=16, max_prompt=128,
-                max_new_hi=64, decode_fuse=None, seed=0):
+                max_new_hi=64, decode_fuse=None, seed=0, kernel="auto"):
     """Ragged continuous batching vs the padded static-batch baseline on
     the SAME synthetic mixed-length stream. Returns the comparison dict
     ``bench.py --serve`` embeds (and summarizes in its truncation-proof
     tail). ``decode_fuse=None`` = consult the autotuned table (the config
-    block reports the value AND which layer supplied it)."""
+    block reports the value AND which layer supplied it). ``kernel``
+    selects the decode-attention A/B leg (see the module docstring): the
+    gather legs are ALWAYS pinned to the gather path so the ledger
+    baseline stays comparable across flag environments."""
     from paddle_tpu import serving
+    from paddle_tpu.flags import flags, set_flag
     from paddle_tpu.models import decoder_lm
 
+    if kernel not in ("auto", "gather", "paged"):
+        raise ValueError("kernel must be auto|gather|paged, got %r" % kernel)
     decode_fuse, fuse_src = resolve_decode_fuse(decode_fuse, slots)
     cfg = decoder_lm.DecoderConfig(vocab_size=vocab, n_layer=n_layer,
                                    d_model=d_model, n_head=n_head,
@@ -148,40 +170,72 @@ def serve_bench(n_requests=64, slots=8, vocab=512, n_layer=4, d_model=128,
     model = decoder_lm.DecoderLM(cfg, seed=seed)
     stream = make_stream(n_requests, vocab, max_prompt, max_new_hi, seed=seed)
 
-    ragged, eng = drive(model, stream, serving.ServingConfig(
-        slots=slots, page_size=page_size, max_seq=max_seq,
-        decode_fuse=decode_fuse, paged=True, continuous=True))
-    padded, _ = drive(model, stream, serving.ServingConfig(
-        slots=slots, page_size=page_size, max_seq=max_seq,
-        decode_fuse=decode_fuse, paged=False, continuous=False))
-    out = {
-        "config": {"requests": n_requests, "slots": slots, "vocab": vocab,
-                   "n_layer": n_layer, "d_model": d_model, "n_head": n_head,
-                   "max_seq": max_seq, "page_size": page_size,
-                   "max_prompt": max_prompt, "max_new_hi": max_new_hi,
-                   "decode_fuse": decode_fuse,
-                   "decode_fuse_source": fuse_src, "seed": seed,
-                   "backend": _backend()},
-        "continuous_paged": ragged,
-        "static_padded": padded,
-        "qps_ratio_vs_padded": round(ragged["qps"] / padded["qps"], 3),
-    }
+    prev_kernel = flags.paged_attention_kernel
+    set_flag("paged_attention_kernel", "off")
     try:
-        # the paged capacity story: HALF the KV pages of the worst case —
-        # ragged lengths mean real occupancy rarely needs it — served by
-        # admission backpressure, not crashes. Reported as its own leg so
-        # the headline ratio stays an equal-memory comparison.
-        half_pages = max(slots, (slots * (max_seq // page_size)) // 2)
-        over, _ = drive(model, stream, serving.ServingConfig(
+        ragged, eng = drive(model, stream, serving.ServingConfig(
             slots=slots, page_size=page_size, max_seq=max_seq,
-            num_pages=half_pages, decode_fuse=decode_fuse,
-            paged=True, continuous=True))
-        over["num_pages"] = half_pages
-        out["continuous_paged_half_pool"] = over
-        out["half_pool_cache_bytes_saved"] = (
-            padded["cache_bytes"] - over["cache_bytes"])
-    except Exception as e:  # the demo leg must never sink the headline
-        out["continuous_paged_half_pool"] = {"error": repr(e)[:200]}
+            decode_fuse=decode_fuse, paged=True, continuous=True))
+        padded, _ = drive(model, stream, serving.ServingConfig(
+            slots=slots, page_size=page_size, max_seq=max_seq,
+            decode_fuse=decode_fuse, paged=False, continuous=False))
+        out = {
+            "config": {"requests": n_requests, "slots": slots, "vocab": vocab,
+                       "n_layer": n_layer, "d_model": d_model,
+                       "n_head": n_head,
+                       "max_seq": max_seq, "page_size": page_size,
+                       "max_prompt": max_prompt, "max_new_hi": max_new_hi,
+                       "decode_fuse": decode_fuse,
+                       "decode_fuse_source": fuse_src, "seed": seed,
+                       "kernel": kernel,
+                       "backend": _backend()},
+            "continuous_paged": ragged,
+            "static_padded": padded,
+            "qps_ratio_vs_padded": round(ragged["qps"] / padded["qps"], 3),
+        }
+        # the A/B leg: SAME stream, SAME geometry, decode attention through
+        # the ragged paged-attention Pallas kernel. "auto" only where it
+        # compiles — the interpreter leg is opt-in (--kernel paged) because
+        # it measures the interpreter, not the kernel.
+        want_kernel = kernel == "paged" or (
+            kernel == "auto" and _backend() == "tpu")
+        if want_kernel:
+            try:
+                set_flag("paged_attention_kernel",
+                         "on" if _backend() == "tpu" else "interpret")
+                kleg, _ = drive(model, stream, serving.ServingConfig(
+                    slots=slots, page_size=page_size, max_seq=max_seq,
+                    decode_fuse=decode_fuse, paged=True, continuous=True))
+                kleg["mode"] = "continuous_paged_kernel"
+                out["continuous_paged_kernel"] = kleg
+                out["kernel_vs_gather"] = {
+                    "qps_ratio": round(kleg["qps"] / ragged["qps"], 3),
+                    "tokens_per_sec_ratio": round(
+                        kleg["tokens_per_sec"] / ragged["tokens_per_sec"],
+                        3),
+                }
+            except Exception as e:  # A/B leg must never sink the baseline
+                out["continuous_paged_kernel"] = {"error": repr(e)[:200]}
+            finally:
+                set_flag("paged_attention_kernel", "off")
+        try:
+            # the paged capacity story: HALF the KV pages of the worst case
+            # — ragged lengths mean real occupancy rarely needs it — served
+            # by admission backpressure, not crashes. Reported as its own
+            # leg so the headline ratio stays an equal-memory comparison.
+            half_pages = max(slots, (slots * (max_seq // page_size)) // 2)
+            over, _ = drive(model, stream, serving.ServingConfig(
+                slots=slots, page_size=page_size, max_seq=max_seq,
+                num_pages=half_pages, decode_fuse=decode_fuse,
+                paged=True, continuous=True))
+            over["num_pages"] = half_pages
+            out["continuous_paged_half_pool"] = over
+            out["half_pool_cache_bytes_saved"] = (
+                padded["cache_bytes"] - over["cache_bytes"])
+        except Exception as e:  # the demo leg must never sink the headline
+            out["continuous_paged_half_pool"] = {"error": repr(e)[:200]}
+    finally:
+        set_flag("paged_attention_kernel", prev_kernel)
     # observability artifact pointers for the summary tail: with
     # PADDLE_TPU_TRACE_FILE set the per-request serving spans land in that
     # Chrome trace at exit (open in Perfetto — one track per slot), and
@@ -203,7 +257,7 @@ def _backend():
 
 def selftest() -> int:
     """Tiny decoder through prefill -> decode -> retire in-process, CPU,
-    <5s: the cheap CI gate for the serving stack. Runs with the host
+    <30s: the CI gate for the serving stack. Runs with the host
     tracer on, so it also asserts the per-request span sets (every
     terminal request complete + well-nested, no queued-without-terminal
     orphans) across the FINISHED, TIMEOUT and FAILED paths."""
@@ -344,8 +398,65 @@ def selftest() -> int:
     trace_path = os.path.join(tempfile.gettempdir(),
                               "serve_bench_trace_%d.json" % os.getpid())
     tracer.save_chrome_trace(trace_path, spans)
+    # --- ragged paged-attention kernel A/B through the REAL bench path ---
+    # (interpret mode on CPU: parity/provenance mechanics, not perf). The
+    # kernel leg's digest must carry per-kernel provenance, and the gather
+    # legs must stay pinned to the gather path regardless of the flag env.
+    from paddle_tpu.flags import flags as _flags
+
+    prev_flag = _flags.paged_attention_kernel
+    res = serve_bench(n_requests=4, slots=2, vocab=64, n_layer=2,
+                      d_model=32, n_head=2, max_seq=64, page_size=8,
+                      max_prompt=12, max_new_hi=5, decode_fuse=1,
+                      kernel="paged")
+    assert _flags.paged_attention_kernel == prev_flag, "flag not restored"
+    kleg = res["continuous_paged_kernel"]
+    assert "error" not in kleg, kleg
+    assert kleg["decode_kernel"] == "paged", kleg
+    assert kleg["decode_kernel_source"] in ("tuned", "shipped", "default")
+    assert res["continuous_paged"]["decode_kernel"] == "gather"
+    assert res["static_padded"]["decode_kernel"] == "gather"
+    assert res["kernel_vs_gather"]["qps_ratio"] > 0
+    # same greedy stream both ways -> the kernel leg generates exactly the
+    # gather baseline's token count (token-level stream parity is pinned
+    # down in tests/test_paged_attention.py)
+    assert kleg["tokens"] == res["continuous_paged"]["tokens"], (
+        kleg["tokens"], res["continuous_paged"]["tokens"])
+    # --- run-ledger + perf-gate mechanics on a throwaway ledger ----------
+    # both kernel variants land as configs in one serve_bench record, and
+    # a steady ledger of them gates NEUTRAL/IMPROVED (never REGRESSED)
+    from paddle_tpu.monitor import runlog
+    from tools import perf_gate
+
+    led = os.path.join(tempfile.mkdtemp(prefix="serve_ledger_"),
+                       "ledger.jsonl")
+    prev_env = os.environ.get("PADDLE_TPU_RUN_LEDGER")
+    os.environ["PADDLE_TPU_RUN_LEDGER"] = led
+    try:
+        configs = {"serve_" + leg: {k: v for k, v in res[leg].items()
+                                    if isinstance(v, (int, float))}
+                   for leg in ("continuous_paged", "static_padded",
+                               "continuous_paged_kernel")}
+        for _ in range(5):
+            rec = runlog.record_run("serve_bench", configs)
+        assert rec.get("ledger_path") == led, rec.get("ledger_path")
+        assert len(runlog.read_ledger(led)) == 5
+        code, verdicts = perf_gate.check_ledger(path=led, quiet=True)
+        assert code == 0, "perf gate flagged identical runs: exit %d" % code
+        assert verdicts, "no verdicts from a 5-record ledger"
+        bad = [v for v in verdicts
+               if v.verdict not in ("NEUTRAL", "IMPROVED")]
+        assert not bad, bad
+    finally:
+        if prev_env is None:
+            os.environ.pop("PADDLE_TPU_RUN_LEDGER", None)
+        else:
+            os.environ["PADDLE_TPU_RUN_LEDGER"] = prev_env
     print("serve_bench selftest: OK (%.1fs)  %d requests traced; "
-          "trace: %s" % (time.perf_counter() - t0, len(digests), trace_path))
+          "kernel leg %s/%s; trace: %s"
+          % (time.perf_counter() - t0, len(digests),
+             kleg["decode_kernel"], kleg["decode_kernel_source"],
+             trace_path))
     return 0
 
 
@@ -361,6 +472,14 @@ def main(argv=None) -> int:
     it = iter(argv)
     for a in it:
         key = a.lstrip("-").replace("-", "_")
+        if key == "kernel":
+            val = next(it)
+            if val not in ("auto", "gather", "paged"):
+                print("--kernel must be auto|gather|paged, got %r" % val,
+                      file=sys.stderr)
+                return 2
+            kw["kernel"] = val
+            continue
         if key not in ("requests", "slots", "seed", "decode_fuse"):
             print("unknown flag %r" % a, file=sys.stderr)
             return 2
@@ -373,7 +492,8 @@ def main(argv=None) -> int:
         from paddle_tpu.monitor import runlog
 
         configs = {}
-        for leg in ("continuous_paged", "static_padded"):
+        for leg in ("continuous_paged", "static_padded",
+                    "continuous_paged_kernel"):
             if isinstance(res.get(leg), dict) and "error" not in res[leg]:
                 configs["serve_" + leg] = {
                     k: v for k, v in res[leg].items()
