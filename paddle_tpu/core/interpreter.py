@@ -51,6 +51,12 @@ def run_block_ops(ops, env: Dict[str, Any], trace, offset: int = 0):
     scopes = getattr(trace, "op_scopes", False)
     watch = getattr(trace, "watch", None) if offset < _SUB_BLOCK_OFFSET \
         else None
+    # streaming tensor statistics (monitor.numerics) ride the same gate:
+    # stat rows born inside a lax body can't be stacked outside it either
+    stats_watch = getattr(trace, "stats_watch", None) \
+        if offset < _SUB_BLOCK_OFFSET else None
+    if stats_watch is not None:
+        from ..monitor.numerics import fold_op_stats
     if scopes:
         import jax
 
@@ -76,6 +82,8 @@ def run_block_ops(ops, env: Dict[str, Any], trace, offset: int = 0):
             raise wrap_op_error(e, op, offset + i, env) from e
         if watch is not None:
             _watch_op_outputs(op, env, watch, offset + i)
+        if stats_watch is not None:
+            fold_op_stats(op, env, stats_watch, offset + i)
 
 
 def _watch_op_outputs(op, env: Dict[str, Any], layout, pos: int) -> None:
@@ -112,9 +120,10 @@ class PerStepTrace:
     index into every op's PRNG key so stochastic ops (dropout etc.) draw a
     fresh mask per timestep instead of reusing the trace-time constant."""
 
-    # loop bodies never collect watchdog bits (they'd leak across the lax
-    # boundary); class attr masks the inner trace's list
+    # loop bodies never collect watchdog bits or stat rows (they'd leak
+    # across the lax boundary); class attrs mask the inner trace's lists
     watch = None
+    stats_watch = None
 
     def __init__(self, inner, step_index):
         self._inner = inner

@@ -35,6 +35,9 @@ from .core.place import Place, get_device
 from .core.registry import OpContext, get_op_impl
 from .core.scope import Scope, global_scope
 from .monitor import GRAD_NORM_VAR, device as _dev, metrics as _mx, tracer as _tr
+from .monitor import numerics as _num
+from .monitor.numerics import NUM_STATS as _NUM_STATS, \
+    STATS_ENV_KEY as _STATS_ENV_KEY
 from .reliability import faults as _faults
 
 __all__ = ["Executor", "FeedError", "FetchHandle", "TraceContext",
@@ -447,7 +450,8 @@ class _CompiledStep:
     def __init__(self, program: Program, feed_names: Tuple[str, ...],
                  fetch_names: Tuple[str, ...], state_names: Tuple[str, ...],
                  is_test: bool, jit: bool = True, mesh=None,
-                 accumulation_steps: int = 1, numerics: bool = False):
+                 accumulation_steps: int = 1, numerics: bool = False,
+                 stats: bool = False):
         self.program = program
         self.feed_names = feed_names
         self.fetch_names = fetch_names
@@ -461,6 +465,13 @@ class _CompiledStep:
         # (index-overwrite, so jit retraces never desync it).
         self.numerics = bool(numerics)
         self.watch_layout: list = []
+        # PADDLE_TPU_NUMERICS>=1: streaming tensor statistics — every op's
+        # floating outputs fold one packed stat row into a [K, NUM_STATS]
+        # hidden trailing fetch (monitor.numerics); stats_layout maps row
+        # k -> (op label, output names, dtype max), same index-overwrite
+        # discipline as watch_layout.
+        self.stats = bool(stats)
+        self.stats_layout: list = []
 
         bw = program._backward_info
         block = program.global_block
@@ -498,6 +509,8 @@ class _CompiledStep:
             trace = TraceContext(program, is_test, rng_key, mesh=mesh)
             if self.numerics:
                 trace.watch = self.watch_layout
+            if self.stats:
+                trace.stats_watch = self.stats_layout
             if bw is None or marker_idx is None:
                 env = dict(state)
                 env.update(feeds)
@@ -618,6 +631,17 @@ class _CompiledStep:
                                 env_i[_NUMERICS_ENV_KEY] = [
                                     jnp.logical_and(a, b)
                                     for a, b in zip(prev, cur)]
+                        if self.stats:
+                            # merge stat rows across microbatches the same
+                            # way (absmax by max, sums add) so a chunk's
+                            # stats cover every microbatch, not just the
+                            # last one
+                            prev = env_prev.get(_STATS_ENV_KEY)
+                            cur = env_i.get(_STATS_ENV_KEY)
+                            if prev and cur:
+                                env_i[_STATS_ENV_KEY] = [
+                                    _num.merge_stat_rows(a, b)
+                                    for a, b in zip(prev, cur)]
                         g_acc = jax.tree_util.tree_map(jnp.add, g_acc, gi)
                         return (g_acc, l_acc + li, env_i), None
 
@@ -660,6 +684,13 @@ class _CompiledStep:
                 bits = env.get(_NUMERICS_ENV_KEY)
                 fetches.append(jnp.stack(bits) if bits
                                else jnp.ones((1,), jnp.bool_))
+            if self.stats:
+                # the packed stat rows ride as the VERY last hidden fetch
+                # (after the watchdog mask when both are armed); run()/
+                # run_steps pop in reverse append order
+                rows = env.get(_STATS_ENV_KEY)
+                fetches.append(jnp.stack(rows) if rows
+                               else jnp.zeros((1, _NUM_STATS), jnp.float32))
             return new_state, fetches
 
         # the raw (unjitted) step closure: _CompiledStepChain scans over it
@@ -767,17 +798,19 @@ class _DispatchPlan:
     """
 
     __slots__ = ("feed_specs", "fetch_names", "run_fetch_names",
-                 "grad_norm_fetch", "numerics", "state_names", "avail_names",
-                 "compiled", "key", "put_specs", "batch_sh", "mesh_repl")
+                 "grad_norm_fetch", "numerics", "stats", "state_names",
+                 "avail_names", "compiled", "key", "put_specs", "batch_sh",
+                 "mesh_repl")
 
     def __init__(self, feed_specs, fetch_names, run_fetch_names,
-                 grad_norm_fetch, numerics, state_names, avail_names,
+                 grad_norm_fetch, numerics, stats, state_names, avail_names,
                  compiled, key, put_specs=None, batch_sh=None, mesh_repl=None):
         self.feed_specs = feed_specs  # tuple of (name, np.dtype, shape)
         self.fetch_names = fetch_names
         self.run_fetch_names = run_fetch_names
         self.grad_norm_fetch = grad_norm_fetch
         self.numerics = numerics  # guarded variant: watchdog mask fetch last
+        self.stats = stats  # stats variant: packed stat rows fetch after it
         self.state_names = state_names
         self.avail_names = avail_names  # state vars present at plan build
         self.compiled = compiled
@@ -1003,6 +1036,15 @@ class Executor:
                     _update_hbm_gauges()
             if was_miss and compiled.jitted and _dev.profile_enabled():
                 self._publish_device_profile(compiled, new_state, feeds)
+            if plan.stats:
+                # stat rows ride after the watchdog mask, so they pop first;
+                # accumulate BEFORE check_numerics_mask so the trip chunk's
+                # range history still lands in the registries/flight dump
+                _num.accumulate(fetches[-1], compiled.stats_layout,
+                                fingerprint=_dev.program_fingerprint(
+                                    src_program),
+                                driver="run")
+                fetches = fetches[:-1]
             mask = None
             if plan.numerics:
                 # the packed per-op isfinite mask is the LAST hidden fetch
@@ -1046,7 +1088,8 @@ class Executor:
 
     # -- dispatch-plan machinery ----------------------------------------------
     def _resolve_plan(self, program, feed, fetch_names, scope, mesh,
-                      accumulation_steps, mx_on, tr_on, use_program_cache):
+                      accumulation_steps, mx_on, tr_on, use_program_cache,
+                      sample_stats=True):
         """(plan, canonical feeds, state, was_compile_miss) for this run.
 
         The hit path does near-zero bookkeeping: one dict lookup on the
@@ -1066,6 +1109,21 @@ class Executor:
         # keys so flipping the env var mid-process re-specializes instead of
         # silently reusing the unguarded step
         numerics = _dev.numerics_level() >= 2
+        # PADDLE_TPU_NUMERICS>=1 compiles the STATS variant (packed per-op
+        # stat rows, _CompiledStep stats=True) — this read is the entire
+        # level-0 cost, and it joins both cache keys for the same
+        # no-silent-reuse reason as the watchdog flag. Armed, only every
+        # Nth chunk runs the stats variant (PADDLE_TPU_NUMERICS_EVERY,
+        # chunk 0 always sampled): both variants sit side by side in the
+        # plan/compile caches, so steady state alternates between two
+        # cache hits and the per-op reduction cost is paid 1/N of the time
+        stats = _num.stats_level() >= 1
+        if stats and sample_stats:
+            every = _num.stats_every()
+            if every > 1:
+                k = getattr(program, "_numerics_chunk", 0)
+                program._numerics_chunk = k + 1
+                stats = (k % every) == 0
         feed_names = tuple(sorted(feed))
         mesh_id = id(mesh) if mesh is not None else None
         # shapes are part of the key so alternating batch shapes (the last
@@ -1075,7 +1133,7 @@ class Executor:
         feed_shapes = tuple(getattr(feed[n], "shape", None)
                             for n in feed_names)
         plan_key = (feed_names, feed_shapes, fetch_names, is_test, mesh_id,
-                    accumulation_steps, grad_norm_fetch, numerics)
+                    accumulation_steps, grad_norm_fetch, numerics, stats)
 
         plans = None
         if use_program_cache:
@@ -1148,6 +1206,7 @@ class Executor:
             mesh_id,
             accumulation_steps,
             numerics,
+            stats,
         )
         compiled = self._cache.get(key) if use_program_cache else None
         was_miss = compiled is None
@@ -1174,6 +1233,7 @@ class Executor:
                     mesh=mesh,
                     accumulation_steps=accumulation_steps,
                     numerics=numerics,
+                    stats=stats,
                 )
             if mx_on:
                 _m_trace_ms.observe((time.perf_counter() - t_build) * 1e3)
@@ -1199,7 +1259,7 @@ class Executor:
             batch_sh = NamedSharding(mesh, _mesh_batch_spec(mesh))
 
         plan = _DispatchPlan(tuple(feed_specs), fetch_names, run_fetch_names,
-                             grad_norm_fetch, numerics, state_names,
+                             grad_norm_fetch, numerics, stats, state_names,
                              avail_state_names, compiled, key, put_specs,
                              batch_sh, mesh_repl)
         if plans is not None:
@@ -1452,9 +1512,14 @@ class Executor:
                                 scope.set_var(name, v)
                         plan = None
                 if plan is None:
+                    # sample_stats=False: the resolved plan persists across
+                    # the whole stream, so a sampled decision would freeze
+                    # arbitrarily — armed run_steps chunks are always
+                    # observed (one fused chunk is one EMA tick already)
                     plan, feeds0, state, chunk_was_miss = self._resolve_plan(
                         program, chunk[0], fetch_names, scope, mesh,
-                        accumulation_steps, mx_on, tr_on, True)
+                        accumulation_steps, mx_on, tr_on, True,
+                        sample_stats=False)
                     chunk_feeds = [feeds0]
                     chunk_feeds += [self._canon_chunk_feed(plan, f)
                                     for f in chunk[1:]]
@@ -1508,6 +1573,15 @@ class Executor:
                         _update_hbm_gauges()
                 consumed += n
 
+                if plan.stats:
+                    # stat rows pop first (stacked [n, K, S] for a fused
+                    # chunk); accumulated before the watchdog check so the
+                    # trip chunk's range history still lands host-side
+                    _num.accumulate(
+                        fetches[-1], plan.compiled.stats_layout,
+                        fingerprint=_dev.program_fingerprint(src_program),
+                        driver="run_steps")
+                    fetches = fetches[:-1]
                 mask = None
                 if plan.numerics:
                     # the per-op isfinite mask rides last; a fused chunk's is

@@ -57,8 +57,8 @@ from __future__ import annotations
 import os
 
 from . import (  # noqa: F401
-    budgets, device, metrics, regress, runlog, slo, stepstats, telemetry,
-    tracer,
+    budgets, device, metrics, numerics, regress, runlog, slo, stepstats,
+    telemetry, tracer,
 )
 from .metrics import (  # noqa: F401
     counter, gauge, histogram, enabled, enable, disable,
@@ -69,8 +69,8 @@ from .step_logger import StepLogger  # noqa: F401
 from .telemetry import TelemetryExporter  # noqa: F401
 
 __all__ = [
-    "budgets", "device", "metrics", "regress", "runlog", "slo", "stepstats",
-    "telemetry", "tracer",
+    "budgets", "device", "metrics", "numerics", "regress", "runlog", "slo",
+    "stepstats", "telemetry", "tracer",
     "StepLogger", "SLO", "SLOMonitor", "TelemetryExporter",
     "counter", "gauge", "histogram", "enabled", "enable", "disable",
     "snapshot", "to_json", "to_text", "to_prometheus", "reset",

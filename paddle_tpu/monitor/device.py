@@ -583,6 +583,18 @@ class FlightRecorder:
                     "deltas": last.deltas}
         except Exception:
             pass
+        try:
+            # with PADDLE_TPU_NUMERICS armed, embed the per-op range
+            # history — a NaN dump then shows the offending op's absmax
+            # trajectory, not just the trip bit
+            from . import numerics as _numerics
+
+            if _numerics.stats_level() >= 1:
+                snap = _numerics.snapshot()
+                if snap:
+                    doc["numerics_last"] = snap
+        except Exception:
+            pass
         with open(path, "w") as f:
             json.dump(doc, f, indent=1, default=str)
         return path

@@ -38,7 +38,7 @@ __all__ = [
     "enabled", "enable", "disable",
     "snapshot", "to_json", "to_text", "to_prometheus", "prometheus_name",
     "reset",
-    "DEFAULT_TIME_BUCKETS_MS", "sorted_percentile",
+    "DEFAULT_TIME_BUCKETS_MS", "log_buckets", "sorted_percentile",
 ]
 
 
@@ -66,6 +66,39 @@ DEFAULT_TIME_BUCKETS_MS: Sequence[float] = (
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
     250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0, 30000.0,
 )
+
+
+def log_buckets(lo: float, hi: float,
+                per_decade: int = 3) -> Sequence[float]:
+    """Geometric histogram bounds covering ``[lo, hi]`` with
+    ``per_decade`` buckets per factor of 10 — the bucket scheme for
+    quantities spanning many orders of magnitude (tensor absmax ranges run
+    1e-8..1e4; no linear ladder holds that). Bounds are plain floats, so
+    the existing Histogram/snapshot/to_prometheus machinery needs no
+    special casing. ``hi`` is always included as the last bound."""
+    if lo <= 0.0:
+        raise ValueError("log_buckets: lo must be > 0, got %r" % (lo,))
+    if hi <= lo:
+        raise ValueError("log_buckets: need hi > lo, got %r <= %r"
+                         % (hi, lo))
+    if per_decade < 1:
+        raise ValueError("log_buckets: per_decade must be >= 1, got %r"
+                         % (per_decade,))
+    import math
+
+    steps = int(math.ceil(math.log10(hi / lo) * per_decade)) + 1
+    out: List[float] = [float(lo)]
+    for i in range(1, steps):
+        # each bound from lo directly (no cumulative drift), snapped to 10
+        # significant digits so ``le_%g`` labels stay clean
+        nxt = float("%.10g" % (lo * 10.0 ** (i / float(per_decade))))
+        if nxt >= hi:
+            break
+        if nxt > out[-1]:
+            out.append(nxt)
+    if out[-1] < hi:
+        out.append(float(hi))
+    return tuple(out)
 
 
 def enabled() -> bool:

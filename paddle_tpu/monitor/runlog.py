@@ -277,6 +277,19 @@ def record_run(kind: str, configs: Dict[str, dict],
     }
     if extra:
         record["extra"] = extra
+    try:
+        # with PADDLE_TPU_NUMERICS armed, the record carries the run's
+        # final per-op range stats — joins the perf trajectory to the
+        # numerics trajectory on the same run_id (each section of a record
+        # degrades independently, same rule as provenance())
+        from . import numerics as _numerics
+
+        if _numerics.stats_level() >= 1:
+            snap = _numerics.snapshot()
+            if snap:
+                record["numerics_last"] = snap
+    except Exception:
+        pass
     led = _active_ledger()
     record["ledger_path"] = led.append(record) if led is not None else None
     _c_records.inc()

@@ -66,7 +66,7 @@ _m_fatals = _mx.counter(
          "trip at the same step)")
 _m_rule = {r: _mx.counter("sentinel/trips_%s" % r,
                           help="trips attributed to the %s rule" % r)
-           for r in ("nan", "spike", "plateau", "grad_norm")}
+           for r in ("nan", "spike", "plateau", "grad_norm", "drift")}
 
 _WATCHDOG_OP_RE = re.compile(r"first produced by op (\S+)")
 
@@ -115,6 +115,7 @@ class DivergenceSentinel:
                  plateau_window: Optional[int] = None,
                  plateau_min_delta: float = 0.0,
                  max_grad_norm: Optional[float] = None,
+                 drift: bool = False,
                  loss_index: int = 0,
                  max_trips: int = 3,
                  lr_backoff: Optional[float] = None,
@@ -133,6 +134,13 @@ class DivergenceSentinel:
         self.plateau_window = plateau_window
         self.plateau_min_delta = float(plateau_min_delta)
         self.max_grad_norm = max_grad_norm
+        # opt-in: trip on monitor.numerics drift early-warnings (an op's
+        # absmax trending toward overflow / collapsing to zero) — the
+        # PREDICTIVE rule; it fires chunks before the nan rule can see a
+        # non-finite loss, while a rollback + LR backoff can still help.
+        # Inert unless PADDLE_TPU_NUMERICS is also armed (no stats, no
+        # drift events to drain).
+        self.drift = bool(drift)
         self.loss_index = int(loss_index)
         self.max_trips = int(max_trips)
         self.lr_backoff = lr_backoff
@@ -224,6 +232,20 @@ class DivergenceSentinel:
                         0, "grad_norm",
                         "grad global norm %.6g exceeds ceiling %.6g"
                         % (gn, self.max_grad_norm), chunk_steps=len(rows))
+        if self.drift:
+            from ..monitor import numerics as _num
+
+            events = _num.drain_drift_events()
+            if events:
+                ev = events[0]
+                horizon = ev.get("chunks_to_overflow")
+                return SentinelTrip(
+                    0, "drift",
+                    "op %s absmax %.6g %s%s" % (
+                        ev["op"], ev["absmax"], ev["kind"],
+                        "" if horizon is None else
+                        " (~%.1f chunks to overflow)" % horizon),
+                    named_op=ev["op"], chunk_steps=len(rows))
         return None
 
     # -- trip bookkeeping (called by the supervisor) --------------------------

@@ -35,7 +35,8 @@ padded static baseline), ``python -m tools.serve_bench --selftest``.
 
 from . import trace  # noqa: F401
 from .engine import ServingConfig, ServingEngine  # noqa: F401
-from .kv_cache import ContiguousKVCache, PagedKVCache  # noqa: F401
+from .kv_cache import (  # noqa: F401
+    ContiguousKVCache, Int8PagedKVCache, PagedKVCache)
 from .page_pool import PagePool, PagePoolExhausted  # noqa: F401
 from .request import (  # noqa: F401
     FAILED, FINISHED, QUEUED, REJECTED, RUNNING, TIMEOUT, BackpressureError,
@@ -44,7 +45,7 @@ from .scheduler import Scheduler  # noqa: F401
 
 __all__ = [
     "ServingConfig", "ServingEngine",
-    "PagedKVCache", "ContiguousKVCache",
+    "PagedKVCache", "Int8PagedKVCache", "ContiguousKVCache",
     "PagePool", "PagePoolExhausted",
     "Scheduler", "Request", "BackpressureError", "DrainingError",
     "QUEUED", "RUNNING", "FINISHED", "TIMEOUT", "FAILED", "REJECTED",

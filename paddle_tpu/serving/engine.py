@@ -36,7 +36,7 @@ from ..monitor import device as _dev, slo as _slo, telemetry as _telemetry
 from ..reliability import faults as _faults
 from . import metrics as _sm
 from . import trace as _trace
-from .kv_cache import ContiguousKVCache, PagedKVCache
+from .kv_cache import ContiguousKVCache, Int8PagedKVCache, PagedKVCache
 from .page_pool import PagePool, PagePoolExhausted
 from .request import (FAILED, FINISHED, REJECTED, TIMEOUT, DrainingError,
                       Request)
@@ -109,6 +109,13 @@ class ServingConfig:
     ``continuous=False`` degrades to the padded static wave-drain baseline;
     ``paged=False`` swaps in the contiguous reference cache. ``eos_id=None``
     disables EOS stopping (generation runs to ``max_new_tokens``).
+    ``kv_dtype="int8"`` requests quantized KV pages
+    (:class:`~.kv_cache.Int8PagedKVCache` — half the bf16 page bytes, so
+    the same HBM budget holds 2× the pages); it engages only when a
+    calibrated scale for this model's KV fingerprint exists
+    (``paddle_tpu.monitor.numerics``, ``PADDLE_TPU_NUMERICS=2``), and
+    falls back to the fp cache otherwise — serving must come up even with
+    no calibration table on disk.
 
     Failure policy: ``decode_retries`` bounds in-place retries of a decode
     dispatch whose failure classifies as transient
@@ -136,7 +143,11 @@ class ServingConfig:
                  pad_id: int = 0, decode_retries: int = 2,
                  fail_fast: bool = False,
                  slos: Optional[Sequence] = None,
-                 drain_timeout_s: float = 30.0):
+                 drain_timeout_s: float = 30.0,
+                 kv_dtype: Optional[str] = None):
+        if kv_dtype not in (None, "int8"):
+            raise ValueError("kv_dtype must be None or 'int8', got %r"
+                             % (kv_dtype,))
         if max_seq % page_size != 0:
             raise ValueError("max_seq=%d must be a multiple of page_size=%d"
                              % (max_seq, page_size))
@@ -165,6 +176,11 @@ class ServingConfig:
         self.fail_fast = bool(fail_fast)
         self.slos = list(slos) if slos else []
         self.drain_timeout_s = float(drain_timeout_s)
+        # "int8": quantized KV pages — honored only when paged AND a
+        # calibrated scale exists for this model's KV fingerprint
+        # (monitor.numerics.kv_scale); otherwise the engine falls back to
+        # the fp cache with a vlog warning instead of refusing to serve
+        self.kv_dtype = kv_dtype
 
     def _tuned_decode_fuse(self):
         """(value, source) from the autotuned config table; (1, "default")
@@ -199,10 +215,20 @@ class ServingEngine:
                 "small for the context budget)" % (mcfg.max_seq, self.cfg.max_seq))
         self.params = params if params is not None else model.params
         if self.cfg.paged:
-            self.cache_ops = PagedKVCache(
-                mcfg.n_layer, mcfg.n_head, mcfg.d_head, self.cfg.slots,
-                self.cfg.max_seq, self.cfg.page_size, self.cfg.num_pages,
-                dtype=mcfg.dtype)
+            kv_scales = None
+            if self.cfg.kv_dtype == "int8":
+                kv_scales = self._calibrated_kv_scales(mcfg)
+            if kv_scales is not None:
+                self.cache_ops = Int8PagedKVCache(
+                    mcfg.n_layer, mcfg.n_head, mcfg.d_head, self.cfg.slots,
+                    self.cfg.max_seq, self.cfg.page_size, self.cfg.num_pages,
+                    k_scale=kv_scales[0], v_scale=kv_scales[1],
+                    dtype=mcfg.dtype)
+            else:
+                self.cache_ops = PagedKVCache(
+                    mcfg.n_layer, mcfg.n_head, mcfg.d_head, self.cfg.slots,
+                    self.cfg.max_seq, self.cfg.page_size, self.cfg.num_pages,
+                    dtype=mcfg.dtype)
             self.pool: Optional[PagePool] = PagePool(
                 self.cfg.num_pages, self.cfg.page_size)
         else:
@@ -258,6 +284,28 @@ class ServingEngine:
                     "PADDLE_TPU_TELEMETRY_DIR is unset — no export ticks "
                     "will run, so the SLOs are inert (health() cannot "
                     "degrade on them)", len(specs))
+
+    @staticmethod
+    def _calibrated_kv_scales(mcfg):
+        """(k_scale, v_scale) for this model's KV fingerprint, or None when
+        no calibration exists (or ANY lookup failure — the int8 request
+        then degrades to the fp cache, because serving must come up even
+        with a missing/corrupt calibration table)."""
+        from ..log import vlog
+        from ..monitor import numerics as _num
+
+        try:
+            fp = _num.kv_fingerprint(mcfg.n_layer, mcfg.n_head, mcfg.d_head,
+                                     mcfg.dtype)
+            scales = _num.kv_scale(fp)
+        except Exception:
+            scales = None
+        if scales is None:
+            vlog(1, "ServingEngine: kv_dtype='int8' requested but no "
+                    "calibrated KV scale found (run a calibration pass: "
+                    "PADDLE_TPU_NUMERICS=2 or numerics."
+                    "record_kv_calibration) — falling back to fp pages")
+        return scales
 
     # -- public API -----------------------------------------------------------
     def close(self) -> None:
@@ -446,6 +494,12 @@ class ServingEngine:
                                           "explicit"),
             "decode_kernel": kern,
             "decode_kernel_source": kern_src,
+            # the layout actually serving (int8 requests silently fall back
+            # to fp when uncalibrated — this is where that shows)
+            "kv_layout": self.cache_ops.layout,
+            "kv_dtype": ("int8" if isinstance(self.cache_ops,
+                                              Int8PagedKVCache)
+                         else str(self.cache_ops.dtype)),
         }
         if self.pool is not None:
             out["pages_in_use"] = self.pool.num_used

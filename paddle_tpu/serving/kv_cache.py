@@ -31,7 +31,7 @@ from typing import Dict, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["PagedKVCache", "ContiguousKVCache"]
+__all__ = ["PagedKVCache", "Int8PagedKVCache", "ContiguousKVCache"]
 
 Cache = Dict[str, jnp.ndarray]
 
@@ -155,6 +155,100 @@ class PagedKVCache(_KVCacheBase):
             "k": state["k"].at[layer, flat].set(k_new, mode="drop"),
             "v": state["v"].at[layer, flat].set(v_new, mode="drop"),
         }
+
+
+class Int8PagedKVCache(PagedKVCache):
+    """Paged layout with int8 KV pages: each pool row stores symmetric
+    int8 quantized K/V, dequantized through per-page fp32 scale arrays
+    (``"ks"``/``"vs"``, ``[n_layer, num_pages]`` — the scale rides the page
+    metadata, so a page is self-describing wherever its id travels).
+
+    The scales are FIXED at construction from a calibrated amax
+    (``monitor.numerics.kv_scale``) — a write never rescales a page, which
+    is exactly why this layout is gated behind calibration: without a
+    trustworthy amax the fixed grid would silently clip. ``self.dtype``
+    stays the COMPUTE dtype (`context` returns it), so the model's decode
+    loop and the attention ops stay layout-blind; only the pool storage and
+    ``cache_bytes`` see int8 — half the page bytes of bf16, a quarter of
+    fp32, which under the PagePool's unchanged reservation math doubles
+    (resp. quadruples) the page capacity of the same byte budget
+    (tools/serve_bench.py asserts the capacity and decode-parity claims).
+
+    ``decode_attention`` always takes the gather path: the ragged Pallas
+    kernel reads raw pool rows and has no dequant stage, so the kernel
+    dispatch is bypassed rather than fed garbage — both decode paths
+    (fused decode scan and prefill-side attention) dequantize through
+    ``context``.
+    """
+
+    layout = "paged-int8"
+
+    def __init__(self, n_layer: int, n_head: int, d_head: int, slots: int,
+                 max_ctx: int, page_size: int, num_pages: int,
+                 k_scale: float, v_scale: float, dtype=jnp.float32):
+        super().__init__(n_layer, n_head, d_head, slots, max_ctx,
+                         page_size, num_pages, dtype)
+        if not (float(k_scale) > 0.0 and float(v_scale) > 0.0):
+            raise ValueError(
+                "Int8PagedKVCache needs calibrated positive scales, got "
+                "k_scale=%r v_scale=%r — run a calibration pass "
+                "(PADDLE_TPU_NUMERICS=2 / numerics.record_kv_calibration) "
+                "first" % (k_scale, v_scale))
+        self.k_scale = float(k_scale)
+        self.v_scale = float(v_scale)
+
+    def init_state(self) -> Cache:
+        shp = (self.n_layer, self.num_rows, self.n_head, self.d_head)
+        return {
+            "k": jnp.zeros(shp, jnp.int8),
+            "v": jnp.zeros(shp, jnp.int8),
+            "pt": jnp.zeros((self.slots, self.pages_per_slot), jnp.int32),
+            "ks": jnp.full((self.n_layer, self.num_pages), self.k_scale,
+                           jnp.float32),
+            "vs": jnp.full((self.n_layer, self.num_pages), self.v_scale,
+                           jnp.float32),
+        }
+
+    def _quant(self, x, scale: float):
+        return jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
+                        -127, 127).astype(jnp.int8)
+
+    def write_token(self, state: Cache, layer: int, k_new, v_new, pos,
+                    active) -> Cache:
+        return super().write_token(state, layer,
+                                   self._quant(k_new, self.k_scale),
+                                   self._quant(v_new, self.v_scale),
+                                   pos, active)
+
+    def write_prompt(self, state: Cache, layer: int, k_new, v_new, dest,
+                     length) -> Cache:
+        return super().write_prompt(state, layer,
+                                    self._quant(k_new, self.k_scale),
+                                    self._quant(v_new, self.v_scale),
+                                    dest, length)
+
+    def context(self, state: Cache, layer: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        ps = self.page_size
+        pt = state["pt"]
+        rows = (pt * ps)[:, :, None] + jnp.arange(ps)[None, None, :]
+        rows = rows.reshape(pt.shape[0], self.max_ctx)
+        pages = rows // ps  # page id per logical position [slots, max_ctx]
+        ks = state["ks"][layer][pages][:, :, None, None].astype(self.dtype)
+        vs = state["vs"][layer][pages][:, :, None, None].astype(self.dtype)
+        return (state["k"][layer][rows].astype(self.dtype) * ks,
+                state["v"][layer][rows].astype(self.dtype) * vs)
+
+    def decode_attention(self, state: Cache, layer: int, q, ctx_len,
+                         sm_scale: float = 1.0) -> jnp.ndarray:
+        from ..ops import attention_ops
+
+        ctx_k, ctx_v = self.context(state, layer)
+        return attention_ops.decode_attention(q, ctx_k, ctx_v, ctx_len,
+                                              sm_scale=sm_scale)
+
+    def cache_bytes(self, state: Cache) -> int:
+        return int(state["k"].nbytes + state["v"].nbytes
+                   + state["ks"].nbytes + state["vs"].nbytes)
 
 
 class ContiguousKVCache(_KVCacheBase):

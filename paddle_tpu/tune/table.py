@@ -183,14 +183,16 @@ def entry_key(kernel: str, bucket: str, device: str) -> str:
 # -- load / store -------------------------------------------------------------
 
 
-def _valid_entries(doc: Any, path: str) -> Dict[str, dict]:
+def _valid_entries(doc: Any, path: str, fmt: str = FORMAT) -> Dict[str, dict]:
     """Schema-check a parsed table document; raises ValueError on anything
-    a partially-written or foreign file could look like."""
+    a partially-written or foreign file could look like. ``fmt`` lets other
+    subsystems (monitor.numerics calibration tables) reuse the whole
+    read/validate/publish discipline under their own format tag."""
     if not isinstance(doc, dict) or not isinstance(doc.get("entries"), dict):
         raise ValueError("%s: not a tune-table document" % path)
-    fmt = doc.get("format")
-    if fmt != FORMAT:
-        raise ValueError("%s: unknown format %r (want %r)" % (path, fmt, FORMAT))
+    got = doc.get("format")
+    if got != fmt:
+        raise ValueError("%s: unknown format %r (want %r)" % (path, got, fmt))
     out = {}
     for key, ent in doc["entries"].items():
         if not (isinstance(key, str) and key.count("|") == 2
@@ -201,7 +203,8 @@ def _valid_entries(doc: Any, path: str) -> Dict[str, dict]:
     return out
 
 
-def read_entries(path: Optional[str]) -> Optional[Dict[str, dict]]:
+def read_entries(path: Optional[str],
+                 fmt: str = FORMAT) -> Optional[Dict[str, dict]]:
     """Entries of the table file at ``path`` (mtime-cached), or None when
     the file is absent OR corrupt — corruption is logged ONCE per file and
     counted, never raised (lookups fall through to the next layer)."""
@@ -211,7 +214,7 @@ def read_entries(path: Optional[str]) -> Optional[Dict[str, dict]]:
         st = os.stat(path)
     except OSError:
         return None
-    sig = (st.st_mtime_ns, st.st_size)
+    sig = (st.st_mtime_ns, st.st_size, fmt)
     with _lock:
         cached = _file_cache.get(path)
         if cached is not None and cached[0] == sig:
@@ -219,7 +222,7 @@ def read_entries(path: Optional[str]) -> Optional[Dict[str, dict]]:
     entries: Optional[Dict[str, dict]]
     try:
         with open(path) as f:
-            entries = _valid_entries(json.load(f), path)
+            entries = _valid_entries(json.load(f), path, fmt)
     except Exception as e:
         entries = None
         if _mx._enabled:
@@ -237,13 +240,14 @@ def read_entries(path: Optional[str]) -> Optional[Dict[str, dict]]:
     return entries
 
 
-def write_entries(path: str, entries: Dict[str, dict]) -> str:
+def write_entries(path: str, entries: Dict[str, dict],
+                  fmt: str = FORMAT) -> str:
     """Atomically publish ``entries`` as the table at ``path`` (tmp file +
     ``os.replace`` in the same directory, so readers only ever see a
     complete document)."""
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
-    doc = {"format": FORMAT, "entries": entries}
+    doc = {"format": fmt, "entries": entries}
     tmp = os.path.join(d, ".%s.tmp.%d" % (os.path.basename(path), os.getpid()))
     with open(tmp, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)

@@ -154,6 +154,59 @@ def test_watchdog_under_run_steps_fused_chunk(monkeypatch, opt_level):
     assert "run_steps" in msg
 
 
+def test_watchdog_and_stats_exclude_sub_blocks(monkeypatch):
+    """Regression: CHECK_NUMERICS=2 (and armed streaming stats) over a
+    While sub-block must compile and run — a watchdog bit or stat row
+    born inside a lax.while body cannot be stacked outside it, so the
+    interpreter gates both collectors on the sub-block offset. Top-level
+    ops keep full attribution; sub-block ops contribute nothing."""
+    from paddle_tpu.monitor import numerics as num
+
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NUMERICS", "2")
+    monkeypatch.setenv("PADDLE_TPU_NUMERICS", "1")
+    monkeypatch.setenv("PADDLE_TPU_NUMERICS_EVERY", "1")
+    num.reset()
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4])
+            i = fluid.layers.fill_constant([1], "int32", 0)
+            n = fluid.layers.fill_constant([1], "int32", 4)
+            s = fluid.layers.fill_constant([1], "float32", 0.0)
+            cond = fluid.layers.less_than(i, n)
+            w = fluid.layers.While(cond)
+            with w.block():
+                fluid.layers.assign(fluid.layers.cast(i, "float32") + s, s)
+                fluid.layers.increment(i, value=1, in_place=True)
+                fluid.layers.less_than(i, n, cond=cond)
+            bad = fluid.layers.log(x)
+            out = fluid.layers.mean(bad)
+        log_idx = [k for k, op in enumerate(main.global_block.ops)
+                   if op.type == "log"][0]
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        ones = np.ones((2, 4), "float32")
+        sv, ov = exe.run(main, feed={"x": ones}, fetch_list=[s, out])
+        assert float(np.asarray(sv).item()) == sum(range(4))
+        assert np.isfinite(np.asarray(ov)).all()
+        # streaming stats saw only top-level ops: every recorded label's
+        # slot sits below the 10_000 sub-block offset, and none of the
+        # loop body's op types appear
+        snap = num.snapshot()
+        assert snap, "armed run folded no stats"
+        for label in snap:
+            slot, _, typ = label.partition(":")
+            assert int(slot) < 10_000, label
+            assert typ not in ("increment", "assign"), label
+        # the watchdog still attributes a top-level NaN by source slot
+        with pytest.raises(EnforceNotMet) as ei:
+            exe.run(main, feed={"x": np.zeros((2, 4), "float32")},
+                    fetch_list=[s, out])
+        assert "%d:log" % log_idx in str(ei.value)
+    finally:
+        num.reset()
+
+
 def test_watchdog_silent_on_finite_and_cache_keyed(monkeypatch):
     """Level 2 on finite data: no raise; flipping the env var re-plans
     (guarded/unguarded variants must not share a cache entry)."""

@@ -71,6 +71,43 @@ def test_histogram_bucket_conflict_raises():
         mx.histogram("t/buckets", buckets=[1, 2, 8])
 
 
+def test_log_buckets_geometry():
+    import math
+
+    b = mx.log_buckets(1e-3, 1e3, per_decade=3)
+    assert b[0] == 1e-3 and b[-1] == 1e3
+    assert all(y > x for x, y in zip(b, b[1:]))
+    # interior bounds are geometric: adjacent ratios ~ 10^(1/3)
+    for x, y in zip(b[:-2], b[1:-1]):
+        assert abs(math.log10(y / x) - 1.0 / 3.0) < 0.02, (x, y)
+    # one bucket per decade lands exactly on the powers of ten
+    assert list(mx.log_buckets(1e-2, 1e2, per_decade=1)) == [
+        0.01, 0.1, 1.0, 10.0, 100.0]
+    # a hi that is not on the grid is still included as the last bound
+    assert mx.log_buckets(1.0, 50.0, per_decade=1)[-1] == 50.0
+
+
+def test_log_buckets_rejects_bad_ranges():
+    for lo, hi in ((0.0, 1.0), (-1.0, 1.0), (1.0, 1.0), (2.0, 1.0)):
+        with pytest.raises(ValueError):
+            mx.log_buckets(lo, hi)
+    with pytest.raises(ValueError):
+        mx.log_buckets(1.0, 10.0, per_decade=0)
+
+
+def test_log_bucketed_histogram_counts():
+    h = mx.histogram("t/log_hist",
+                     buckets=mx.log_buckets(1e-2, 1e2, per_decade=1))
+    for v in (0.005, 0.05, 5.0, 500.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 4
+    assert snap["buckets"]["le_0.01"] == 1   # below lo folds into lo
+    assert snap["buckets"]["le_0.1"] == 1
+    assert snap["buckets"]["le_10"] == 1
+    assert snap["buckets"]["le_inf"] == 1    # past hi overflows
+
+
 def test_tracer_span_cap(monkeypatch):
     monkeypatch.setattr(tracer, "_max_spans", 3)
     tracer.start_tracing()

@@ -1,0 +1,415 @@
+"""Numerics observatory (monitor/numerics.py): streaming per-op tensor
+statistics behind PADDLE_TPU_NUMERICS, the chunk-sampling cadence, EMA
+drift early warnings, calibration tables, the sentinel drift rule, the
+int8 KV page path, and the flight/run-ledger embeds."""
+
+import json
+import math
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.monitor import device as dev
+from paddle_tpu.monitor import metrics as mx
+from paddle_tpu.monitor import numerics as num
+
+
+@pytest.fixture(autouse=True)
+def _fresh_numerics():
+    num.reset()
+    yield
+    num.reset()
+
+
+def _scale_prog(factor=2.0):
+    """data -> scale -> mean: one obviously-attributable floating op."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        h = fluid.layers.scale(x, scale=factor)
+        out = fluid.layers.mean(h)
+    return main, startup, out
+
+
+def _label_for(op_type):
+    labels = [k for k in num.snapshot() if k.endswith(":" + op_type)]
+    assert len(labels) == 1, (op_type, sorted(num.snapshot()))
+    return labels[0]
+
+
+# -- env knob parsing ---------------------------------------------------------
+
+def test_stats_level_parsing(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_NUMERICS", raising=False)
+    assert num.stats_level() == 0
+    for raw, want in (("0", 0), ("1", 1), ("2", 2), ("7", 2), ("-3", 0),
+                      ("true", 1), ("junk", 0)):
+        monkeypatch.setenv("PADDLE_TPU_NUMERICS", raw)
+        assert num.stats_level() == want, raw
+
+
+def test_stats_every_parsing(monkeypatch):
+    monkeypatch.delenv(num.EVERY_ENV_KEY, raising=False)
+    assert num.stats_every() == num.DEFAULT_EVERY
+    for raw, want in (("1", 1), ("0", 1), ("-2", 1), ("7", 7),
+                      ("junk", num.DEFAULT_EVERY)):
+        monkeypatch.setenv(num.EVERY_ENV_KEY, raw)
+        assert num.stats_every() == want, raw
+
+
+# -- level 0: the off path ----------------------------------------------------
+
+def test_level0_bit_identity(monkeypatch):
+    """Arming then disarming the observatory must leave the computation
+    bit-identical — off/armed plans live side by side in the plan cache
+    (stats joins the plan key), so disarming never reuses an armed step."""
+    main, startup, out = _scale_prog()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x = np.linspace(-1, 1, 8).astype("float32").reshape(2, 4)
+    monkeypatch.delenv("PADDLE_TPU_NUMERICS", raising=False)
+    r_unset, = exe.run(main, feed={"x": x}, fetch_list=[out])
+    assert not num.snapshot(), "level 0 folded stats"
+    monkeypatch.setenv("PADDLE_TPU_NUMERICS", "1")
+    monkeypatch.setenv(num.EVERY_ENV_KEY, "1")
+    r_armed, = exe.run(main, feed={"x": x}, fetch_list=[out])
+    assert num.snapshot(), "armed run folded no stats"
+    monkeypatch.setenv("PADDLE_TPU_NUMERICS", "0")
+    r_off, = exe.run(main, feed={"x": x}, fetch_list=[out])
+    assert np.asarray(r_unset).tobytes() == np.asarray(r_off).tobytes()
+    np.testing.assert_allclose(np.asarray(r_unset), np.asarray(r_armed),
+                               rtol=1e-6)
+
+
+# -- armed stats: parity against numpy ---------------------------------------
+
+def test_armed_stats_match_numpy(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_NUMERICS", "1")
+    monkeypatch.setenv(num.EVERY_ENV_KEY, "1")
+    main, startup, out = _scale_prog(factor=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x = np.array([[0.0, -1.5, 0.25, 3.0],
+                  [2.0, 0.0, -0.5, 1.0]], "float32")
+    exe.run(main, feed={"x": x}, fetch_list=[out])
+    ref = (2.0 * x).astype(np.float64)
+    st = num.snapshot()[_label_for("scale")]
+    assert st["count"] == ref.size
+    np.testing.assert_allclose(st["absmax"], np.abs(ref).max(), rtol=1e-6)
+    np.testing.assert_allclose(st["mean"], ref.mean(), rtol=1e-5)
+    np.testing.assert_allclose(st["rms"], np.sqrt((ref ** 2).mean()),
+                               rtol=1e-5)
+    assert st["zero_frac"] == pytest.approx((ref == 0).mean())
+    assert st["overflow_frac"] == 0.0 and st["subnormal_frac"] == 0.0
+    assert st["driver"] == "run"
+    # fp32 dtype ceiling rode the layout into the drift detector's hands
+    assert st["dtype_max"] == pytest.approx(float(np.finfo(np.float32).max))
+    # mean op: one element, |mean(2x)|
+    st_mean = num.snapshot()[_label_for("mean")]
+    assert st_mean["count"] == 1
+    np.testing.assert_allclose(st_mean["absmax"], abs(ref.mean()), rtol=1e-5)
+    # the registry mirror carries the same numbers
+    snap = mx.snapshot()
+    key = "numerics/%s/absmax" % _label_for("scale")
+    assert snap[key]["value"] == pytest.approx(np.abs(ref).max(), rel=1e-6)
+
+
+def test_near_overflow_and_zero_fractions(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_NUMERICS", "1")
+    monkeypatch.setenv(num.EVERY_ENV_KEY, "1")
+    main, startup, out = _scale_prog(factor=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    big = num.OVERFLOW_FRACTION * float(np.finfo(np.float32).max) * 2.0
+    x = np.array([[big, 0.0, 0.0, 1.0]], "float32")
+    exe.run(main, feed={"x": x}, fetch_list=[out])
+    st = num.snapshot()[_label_for("scale")]
+    assert st["overflow_frac"] == pytest.approx(0.25)
+    assert st["zero_frac"] == pytest.approx(0.5)
+
+
+# -- chunk sampling -----------------------------------------------------------
+
+def test_chunk_sampling_every(monkeypatch):
+    """PADDLE_TPU_NUMERICS_EVERY=3: chunks 0,3,6 run the stats variant —
+    7 runs fold 3 chunks. run() keeps a per-program chunk counter."""
+    monkeypatch.setenv("PADDLE_TPU_NUMERICS", "1")
+    monkeypatch.setenv(num.EVERY_ENV_KEY, "3")
+    main, startup, out = _scale_prog()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x = np.ones((2, 4), "float32")
+    before = mx.counter("numerics/chunks").value
+    for _ in range(7):
+        exe.run(main, feed={"x": x}, fetch_list=[out])
+    folded = mx.counter("numerics/chunks").value - before
+    assert folded == 3, folded
+    st = num.snapshot()[_label_for("scale")]
+    assert st["chunks"] == 3
+
+
+def test_run_steps_always_observed(monkeypatch):
+    """run_steps resolves ONE plan for the whole stream, so sampling
+    would freeze the decision arbitrarily — armed run_steps chunks are
+    always the stats variant regardless of the cadence."""
+    monkeypatch.setenv("PADDLE_TPU_NUMERICS", "1")
+    monkeypatch.setenv(num.EVERY_ENV_KEY, "1000")
+    main, startup, out = _scale_prog()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x = np.ones((2, 4), "float32")
+    before = mx.counter("numerics/chunks").value
+    feeds = iter([{"x": x}] * 4)
+    exe.run_steps(main, feeds, steps=4, fetch_list=[out], fetch_every=4)
+    assert mx.counter("numerics/chunks").value - before >= 1
+    st = num.snapshot()[_label_for("scale")]
+    assert st["driver"] == "run_steps"
+    # the fused chunk folded its per-step rows into ONE chunk aggregate
+    # (one EMA tick per chunk): counts sum across the 4 fused steps
+    assert st["count"] == 4 * x.size
+
+
+# -- stat-row algebra ---------------------------------------------------------
+
+def test_merge_stat_rows():
+    import jax.numpy as jnp
+
+    a = jnp.asarray([4.0, 1.0, 2.0, 3.0, 0.0, 1.0, 8.0])
+    b = jnp.asarray([2.0, 5.0, 2.0, 1.0, 2.0, 0.0, 8.0])
+    m = np.asarray(num.merge_stat_rows(a, b))
+    assert m[0] == 4.0                      # absmax: max
+    np.testing.assert_allclose(m[1:], np.asarray(a)[1:] + np.asarray(b)[1:])
+
+
+def test_accumulate_never_raises_on_garbage():
+    num.accumulate(np.zeros((2, 3)), [])          # wrong row width
+    num.accumulate("not an array", [])            # not an array
+    num.accumulate(np.zeros((1, num.NUM_STATS)), [])  # placeholder row
+    assert not num.snapshot()
+
+
+# -- drift detection ----------------------------------------------------------
+
+def _feed_ramp(absmaxes, fmax=1e4, label="7:scale"):
+    """Drive accumulate() with synthetic single-op chunks whose absmax
+    follows ``absmaxes`` — the EMA sees one tick per call."""
+    for am in absmaxes:
+        row = np.array([[am, am, am * am, 0.0, 0.0, 0.0, 4.0]], np.float32)
+        num.accumulate(row, [(label, ("out",), fmax)])
+
+
+def test_drift_warns_on_overflow_ramp():
+    with warnings.catch_warnings(record=True) as got:
+        warnings.simplefilter("always")
+        _feed_ramp([2.0 ** k for k in range(1, 9)])  # doubling every chunk
+    drift = [w for w in got if issubclass(w.category,
+                                          num.NumericsDriftWarning)]
+    assert drift, "no NumericsDriftWarning on a doubling absmax ramp"
+    w = drift[0].message
+    assert w.label == "7:scale"
+    assert w.kind == "trending-toward-overflow"
+    assert w.chunks_to_overflow is not None and w.chunks_to_overflow <= 8.0
+    events = num.drain_drift_events()
+    assert events and events[0]["op"] == "7:scale"
+    assert events[0]["kind"] == "trending-toward-overflow"
+    assert not num.drain_drift_events(), "drain did not clear"
+
+
+def test_drift_warns_on_collapse_and_steady_is_silent():
+    with warnings.catch_warnings(record=True) as got:
+        warnings.simplefilter("always")
+        _feed_ramp([1.0] * 8)                  # steady: silence
+    assert not [w for w in got
+                if issubclass(w.category, num.NumericsDriftWarning)]
+    assert not num.drain_drift_events()
+    with warnings.catch_warnings(record=True) as got:
+        warnings.simplefilter("always")
+        _feed_ramp([1.0, 1.0, 0.0])            # live range went dark
+    ev = num.drain_drift_events()
+    assert ev and ev[0]["kind"] == "collapsed-to-zero"
+
+
+def test_drift_event_reaches_flight_ring(monkeypatch, tmp_path):
+    monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setattr(dev, "_flight", None, raising=False)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        _feed_ramp([2.0 ** k for k in range(1, 9)])
+    fr = dev.flight_recorder()
+    assert fr is not None
+    path = fr.dump("test")
+    with open(path) as f:
+        doc = json.load(f)
+    evs = [e for e in doc["entries"] if e.get("event") == "numerics_drift"]
+    assert evs, "drift event missing from the flight ring"
+    assert evs[0]["op"] == "7:scale"
+    assert evs[0]["drift_kind"] == "trending-toward-overflow"
+
+
+def test_sentinel_drift_rule():
+    from paddle_tpu.reliability import DivergenceSentinel
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        _feed_ramp([2.0 ** k for k in range(1, 9)])
+    rows = [(np.float32(0.5),)]
+    # drift disarmed: the queued event is ignored (and stays queued)
+    s0 = DivergenceSentinel(drift=False)
+    assert s0.check_rows(rows, []) is None
+    sen = DivergenceSentinel(drift=True)
+    trip = sen.check_rows(rows, [])
+    assert trip is not None and trip.rule == "drift"
+    assert trip.named_op == "7:scale"
+    assert "trending-toward-overflow" in trip.reason
+    # the drain consumed the queue: a clean chunk does not re-trip
+    assert sen.check_rows(rows, []) is None
+
+
+# -- calibration tables -------------------------------------------------------
+
+def test_calibration_roundtrip_and_running_max(tmp_path):
+    tbl = str(tmp_path / "calib.json")
+    assert num.record_calibration("fp0", "3", "matmul", 2.0, path=tbl) == tbl
+    assert num.lookup_amax("fp0", "3", "matmul", path=tbl) == 2.0
+    # merge is a running max: smaller re-records don't shrink the grid
+    num.record_calibration("fp0", "3", "matmul", 1.0, path=tbl)
+    assert num.lookup_amax("fp0", "3", "matmul", path=tbl) == 2.0
+    num.record_calibration("fp0", "3", "matmul", 8.0, path=tbl)
+    assert num.lookup_amax("fp0", "3", "matmul", path=tbl) == 8.0
+    assert num.lookup_scale("fp0", "3", "matmul", path=tbl) == \
+        pytest.approx(8.0 / 127.0)
+    # the persisted document is the parameterized tune-table format
+    with open(tbl) as f:
+        doc = json.load(f)
+    assert doc["format"] == num.FORMAT
+
+
+def test_calibration_lookups_never_raise(tmp_path):
+    assert num.lookup_amax("fp0", "0", "x", path=str(tmp_path / "no.json")) \
+        is None
+    bad = tmp_path / "bad.json"
+    bad.write_text("{ not json")
+    assert num.lookup_amax("fp0", "0", "x", path=str(bad)) is None
+    assert num.lookup_scale("fp0", "0", "x", path=str(bad)) is None
+    assert num.kv_scale("fp0", path=str(bad)) is None
+
+
+def test_level2_run_publishes_calibration(monkeypatch, tmp_path):
+    tbl = str(tmp_path / "calib.json")
+    monkeypatch.setenv("PADDLE_TPU_NUMERICS", "2")
+    monkeypatch.setenv(num.EVERY_ENV_KEY, "1")
+    monkeypatch.setenv("PADDLE_TPU_NUMERICS_TABLE", tbl)
+    main, startup, out = _scale_prog(factor=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    x = np.full((2, 4), 1.5, "float32")
+    exe.run(main, feed={"x": x}, fetch_list=[out])
+    assert os.path.exists(tbl), "level 2 run published no table"
+    fp = dev.program_fingerprint(main)
+    slot, _, typ = _label_for("scale").partition(":")
+    assert num.lookup_amax(fp, slot, typ, path=tbl) == pytest.approx(3.0)
+    assert num.lookup_scale(fp, slot, typ, path=tbl) == \
+        pytest.approx(3.0 / 127.0)
+
+
+def test_kv_fingerprint_and_scale_gate(tmp_path):
+    tbl = str(tmp_path / "calib.json")
+    fp = num.kv_fingerprint(2, 4, 16, "float32")
+    assert fp == num.kv_fingerprint(2, 4, 16, "float32")   # stable
+    assert fp != num.kv_fingerprint(2, 4, 32, "float32")   # geometry-keyed
+    assert num.kv_scale(fp, path=tbl) is None              # uncalibrated
+    num.record_calibration(fp, "kv", "k", 4.0, path=tbl)
+    assert num.kv_scale(fp, path=tbl) is None              # half missing
+    num.record_kv_calibration(fp, 4.0, 2.0, path=tbl)
+    ks, vs = num.kv_scale(fp, path=tbl)
+    assert ks == pytest.approx(4.0 / 127.0)
+    assert vs == pytest.approx(2.0 / 127.0)
+
+
+# -- int8 KV pages ------------------------------------------------------------
+
+def test_int8_kv_cache_parity_and_bytes():
+    from paddle_tpu.serving.kv_cache import Int8PagedKVCache, PagedKVCache
+
+    geom = dict(n_layer=1, n_head=2, d_head=4, slots=2, max_ctx=16,
+                page_size=4, num_pages=8)
+    rng = np.random.RandomState(0)
+    kv = rng.randn(2, 8, 2, 4).astype("float32")  # [seq,.. ] per slot
+    amax = float(np.abs(kv).max())
+    fp = PagedKVCache(**geom)
+    i8 = Int8PagedKVCache(k_scale=amax / 127.0, v_scale=amax / 127.0, **geom)
+    sf, si = fp.init_state(), i8.init_state()
+    dest = fp.prompt_dest([0, 1])
+    for st, ops in ((sf, fp), (si, i8)):
+        st.update(ops.write_prompt(st, 0, kv[0], kv[1], dest, 8))
+        st["pt"] = st["pt"].at[0].set(dest)
+    kf, vf = (np.asarray(t) for t in fp.context(sf, 0))
+    ki, vi = (np.asarray(t) for t in i8.context(si, 0))
+    # symmetric int8 on a calibrated grid: error bounded by half a step
+    step = amax / 127.0
+    assert np.max(np.abs(kf - ki)) <= 0.5 * step + 1e-6
+    assert np.max(np.abs(vf - vi)) <= 0.5 * step + 1e-6
+    assert i8.cache_bytes(si) < fp.cache_bytes(sf) // 2
+    with pytest.raises(ValueError):
+        Int8PagedKVCache(k_scale=0.0, v_scale=1.0, **geom)
+
+
+def test_engine_int8_gate_degrades_without_calibration(monkeypatch,
+                                                       tmp_path):
+    from paddle_tpu import serving
+    from paddle_tpu.models import decoder_lm
+
+    monkeypatch.setenv("PADDLE_TPU_NUMERICS_TABLE",
+                       str(tmp_path / "calib.json"))
+    cfg = decoder_lm.DecoderConfig(vocab_size=16, n_layer=1, d_model=8,
+                                   n_head=1, max_seq=16)
+    model = decoder_lm.DecoderLM(cfg, seed=0)
+    eng = serving.ServingEngine(model, serving.ServingConfig(
+        slots=1, page_size=8, max_seq=16, kv_dtype="int8"))
+    try:
+        assert eng.cache_ops.layout == "paged", \
+            "uncalibrated int8 request must fall back to fp pages"
+    finally:
+        eng.close()
+    # calibrate, and the SAME config comes up quantized
+    mc = model.cfg
+    num.record_kv_calibration(
+        num.kv_fingerprint(mc.n_layer, mc.n_head, mc.d_head, mc.dtype),
+        2.0, 2.0, path=str(tmp_path / "calib.json"))
+    eng2 = serving.ServingEngine(model, serving.ServingConfig(
+        slots=1, page_size=8, max_seq=16, kv_dtype="int8"))
+    try:
+        assert eng2.cache_ops.layout == "paged-int8"
+        assert eng2.stats()["kv_dtype"] == "int8"
+    finally:
+        eng2.close()
+
+
+# -- embeds -------------------------------------------------------------------
+
+def test_runlog_embed(monkeypatch, tmp_path):
+    from paddle_tpu.monitor import runlog
+
+    monkeypatch.setenv("PADDLE_TPU_RUN_LEDGER", str(tmp_path / "led.jsonl"))
+    monkeypatch.setenv("PADDLE_TPU_NUMERICS", "0")
+    _feed_ramp([1.0])
+    rec = runlog.record_run("bench", {"cfg": {"m": 1.0}})
+    assert "numerics_last" not in rec, "level 0 record embedded stats"
+    monkeypatch.setenv("PADDLE_TPU_NUMERICS", "1")
+    rec = runlog.record_run("bench", {"cfg": {"m": 1.0}})
+    assert rec["numerics_last"]["7:scale"]["absmax"] == 1.0
+    on_disk = runlog.read_ledger(str(tmp_path / "led.jsonl"))
+    assert "numerics_last" in on_disk[-1]
+
+
+def test_flight_dump_embed(monkeypatch, tmp_path):
+    monkeypatch.setenv("PADDLE_TPU_NUMERICS", "1")
+    _feed_ramp([1.0])
+    fr = dev.FlightRecorder(str(tmp_path))
+    path = fr.dump("test")
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["numerics_last"]["7:scale"]["absmax"] == 1.0

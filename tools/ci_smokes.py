@@ -32,6 +32,7 @@ GATES = (
     ("autotune", "tools.autotune"),
     ("check_budgets", "tools.check_budgets"),
     ("perf_gate", "tools.perf_gate"),
+    ("numerics_report", "tools.numerics_report"),
 )
 
 

@@ -389,8 +389,59 @@ def selftest() -> int:
                      "sentinel/records_quarantined", "sentinel/lr_backoffs",
                      "sentinel/fatals", "sentinel/trips_nan",
                      "sentinel/trips_spike", "sentinel/trips_plateau",
-                     "sentinel/trips_grad_norm"):
+                     "sentinel/trips_grad_norm", "sentinel/trips_drift"):
             assert name in snap, "missing instrument %s" % name
+    metrics.reset()
+
+    # 6c. numerics/* registry: the streaming-stats layer must feed per-op
+    #     gauges, the chunks counter and the LOG-BUCKETED absmax histogram
+    #     from a real armed step, render through the table/Prometheus/
+    #     --watch formatters, and leave zero registry residue when off
+    from paddle_tpu.monitor import numerics as _numerics
+    from paddle_tpu.monitor import telemetry as _tele
+
+    metrics.reset()
+    _numerics.reset()
+    prev_num = os.environ.get("PADDLE_TPU_NUMERICS")
+    os.environ["PADDLE_TPU_NUMERICS"] = "1"
+    try:
+        exp = _tele.TelemetryExporter("", interval_s=999.0,
+                                      prometheus_file=False)
+        exp.disabled = True
+        exp.tick()  # baseline so the next tick's deltas cover the run
+        with fluid.unique_name.guard():
+            with fluid.scope_guard(fluid.Scope()):
+                m3, s3 = fluid.Program(), fluid.Program()
+                with fluid.program_guard(m3, s3):
+                    x = fluid.layers.data("x", shape=[4])
+                    h3 = fluid.layers.fc(x, size=4, act="relu")
+                    out3 = fluid.layers.mean(h3)
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(s3)
+                exe.run(m3, feed={"x": np.ones((2, 4), "float32")},
+                        fetch_list=[out3])
+        snap = metrics.snapshot()
+        assert snap["numerics/chunks"]["value"] >= 1, "no stats chunk landed"
+        assert any(k.startswith("numerics/") and k.endswith("/absmax")
+                   for k in snap), "per-op numerics gauges missing"
+        hsnap = snap["numerics/absmax"]
+        assert hsnap["type"] == "histogram" and hsnap["count"] >= 1
+        assert "le_1e-08" in hsnap["buckets"], "log buckets missing"
+        assert _numerics.snapshot(), "host per-op registry empty"
+        # the log-bucketed histogram must survive every renderer
+        assert "numerics/absmax" in format_snapshot(snap)
+        assert 'numerics_absmax_bucket{le="1e-08"}' in metrics.to_prometheus()
+        sample = exp.tick()
+        assert any(line.startswith("numerics/absmax")
+                   for line in _delta_lines(sample)), \
+            "--watch formatter dropped the log-bucketed histogram"
+        exp.stop()
+    finally:
+        if prev_num is None:
+            os.environ.pop("PADDLE_TPU_NUMERICS", None)
+        else:
+            os.environ["PADDLE_TPU_NUMERICS"] = prev_num
+    _numerics.reset()
     metrics.reset()
 
     # 7. continuous telemetry: JSONL ring write/rotate/read-back, interval

@@ -33,6 +33,7 @@ MODULES = [
     "paddle_tpu.monitor.budgets",
     "paddle_tpu.monitor.device",
     "paddle_tpu.monitor.metrics",
+    "paddle_tpu.monitor.numerics",
     "paddle_tpu.monitor.regress",
     "paddle_tpu.monitor.runlog",
     "paddle_tpu.monitor.slo",

@@ -234,6 +234,49 @@ def serve_bench(n_requests=64, slots=8, vocab=512, n_layer=4, d_model=128,
                 padded["cache_bytes"] - over["cache_bytes"])
         except Exception as e:  # the demo leg must never sink the headline
             out["continuous_paged_half_pool"] = {"error": repr(e)[:200]}
+        try:
+            # the quantized-capacity story: calibrate this model's KV
+            # scales from the fp leg's OWN pages (the amax those pages
+            # really saw), publish to a throwaway calibration table, and
+            # serve the SAME stream through int8 pages with TWICE the
+            # page budget — which still costs fewer cache bytes than the
+            # fp pool, while the greedy stream generates the same token
+            # volume (logits tolerance is pinned down in selftest()).
+            import tempfile
+
+            from paddle_tpu.monitor import numerics as _num
+
+            mcfg = model.cfg
+            k_amax = float(np.abs(np.asarray(eng._cache["k"])).max())
+            v_amax = float(np.abs(np.asarray(eng._cache["v"])).max())
+            fp_key = _num.kv_fingerprint(mcfg.n_layer, mcfg.n_head,
+                                         mcfg.d_head, mcfg.dtype)
+            tbl = os.path.join(tempfile.mkdtemp(prefix="serve_calib_"),
+                               "calib.json")
+            _num.record_kv_calibration(fp_key, k_amax, v_amax, path=tbl)
+            prev_tbl = os.environ.get("PADDLE_TPU_NUMERICS_TABLE")
+            os.environ["PADDLE_TPU_NUMERICS_TABLE"] = tbl
+            try:
+                full_pages = slots * (max_seq // page_size)
+                i8, _ = drive(model, stream, serving.ServingConfig(
+                    slots=slots, page_size=page_size, max_seq=max_seq,
+                    num_pages=2 * full_pages, decode_fuse=decode_fuse,
+                    paged=True, continuous=True, kv_dtype="int8"))
+                i8["num_pages"] = 2 * full_pages
+                out["continuous_paged_int8_2x"] = i8
+                out["int8_2x_vs_fp"] = {
+                    "token_parity": i8["tokens"] == ragged["tokens"],
+                    "pages_ratio": 2.0,
+                    "cache_bytes_ratio": round(
+                        i8["cache_bytes"] / ragged["cache_bytes"], 3),
+                }
+            finally:
+                if prev_tbl is None:
+                    os.environ.pop("PADDLE_TPU_NUMERICS_TABLE", None)
+                else:
+                    os.environ["PADDLE_TPU_NUMERICS_TABLE"] = prev_tbl
+        except Exception as e:  # calibration leg must never sink the headline
+            out["continuous_paged_int8_2x"] = {"error": repr(e)[:200]}
     finally:
         set_flag("paged_attention_kernel", prev_kernel)
     # observability artifact pointers for the summary tail: with
@@ -422,6 +465,69 @@ def selftest() -> int:
     # down in tests/test_paged_attention.py)
     assert kleg["tokens"] == res["continuous_paged"]["tokens"], (
         kleg["tokens"], res["continuous_paged"]["tokens"])
+    # --- calibrated int8 KV pages: decode parity + the 2x capacity win ---
+    # the bench's own int8 leg first (it calibrated from the fp leg's
+    # pages and served with DOUBLE the page budget): the gate must have
+    # actually taken (paged-int8 layout, not a silent fp fallback), the
+    # greedy stream must generate the same token volume, and 2x the pages
+    # must still cost fewer cache bytes than the fp pool
+    i8leg = res["continuous_paged_int8_2x"]
+    assert "error" not in i8leg, i8leg
+    assert i8leg["mode"] == "continuous_paged-int8", i8leg["mode"]
+    assert i8leg["tokens"] == res["continuous_paged"]["tokens"], (
+        i8leg["tokens"], res["continuous_paged"]["tokens"])
+    assert i8leg["cache_bytes"] < res["continuous_paged"]["cache_bytes"], (
+        i8leg["cache_bytes"], res["continuous_paged"]["cache_bytes"])
+    assert res["int8_2x_vs_fp"]["token_parity"], res["int8_2x_vs_fp"]
+    # then logits-level parity: the SAME greedy stream through fp vs
+    # calibrated int8 pages with per-token logits captured — the decode
+    # outputs must agree within quantization tolerance, token for token
+    from paddle_tpu.monitor import numerics as _num
+
+    mc = model.cfg
+    prompts = [list(rng.randint(0, 64, int(n))) for n in (6, 11, 17)]
+    eng_fp = serving.ServingEngine(model, serving.ServingConfig(
+        slots=2, page_size=8, max_seq=64, collect_logits=True))
+    p_reqs = [eng_fp.submit(p, 6) for p in prompts]
+    eng_fp.run()
+    k_amax = float(np.abs(np.asarray(eng_fp._cache["k"])).max())
+    v_amax = float(np.abs(np.asarray(eng_fp._cache["v"])).max())
+    tbl = os.path.join(tempfile.mkdtemp(prefix="serve_calib_"),
+                       "calib.json")
+    _num.record_kv_calibration(
+        _num.kv_fingerprint(mc.n_layer, mc.n_head, mc.d_head, mc.dtype),
+        k_amax, v_amax, path=tbl)
+    prev_tbl = os.environ.get("PADDLE_TPU_NUMERICS_TABLE")
+    os.environ["PADDLE_TPU_NUMERICS_TABLE"] = tbl
+    try:
+        eng_i8 = serving.ServingEngine(model, serving.ServingConfig(
+            slots=2, page_size=8, max_seq=64, num_pages=32,
+            collect_logits=True, kv_dtype="int8"))
+        assert eng_i8.cache_ops.layout == "paged-int8", \
+            "calibration gate fell back to fp pages"
+        q_reqs = [eng_i8.submit(p, 6) for p in prompts]
+        eng_i8.run()
+    finally:
+        if prev_tbl is None:
+            os.environ.pop("PADDLE_TPU_NUMERICS_TABLE", None)
+        else:
+            os.environ["PADDLE_TPU_NUMERICS_TABLE"] = prev_tbl
+    i8_err = 0.0
+    for rf, ri in zip(p_reqs, q_reqs):
+        assert rf.tokens_out == ri.tokens_out, (rf.tokens_out, ri.tokens_out)
+        lf = np.stack(eng_fp.captured_logits(rf))
+        li = np.stack(eng_i8.captured_logits(ri))
+        err = float(np.max(np.abs(lf - li)) / (np.max(np.abs(lf)) + 1e-9))
+        i8_err = max(i8_err, err)
+        assert err < 0.02, "int8 KV logits drifted %.4g rel" % err
+    # 32 int8 pages vs 16 fp pages over identical geometry: 2x the
+    # capacity in ~half the bytes (scale arrays included)
+    fp_bytes = eng_fp.cache_ops.cache_bytes(eng_fp._cache)
+    i8_bytes = eng_i8.cache_ops.cache_bytes(eng_i8._cache)
+    assert eng_i8.cache_ops.num_pages == 2 * eng_fp.cache_ops.num_pages
+    assert i8_bytes < fp_bytes, (i8_bytes, fp_bytes)
+    eng_fp.close()
+    eng_i8.close()
     # --- run-ledger + perf-gate mechanics on a throwaway ledger ----------
     # both kernel variants land as configs in one serve_bench record, and
     # a steady ledger of them gates NEUTRAL/IMPROVED (never REGRESSED)
@@ -436,7 +542,8 @@ def selftest() -> int:
         configs = {"serve_" + leg: {k: v for k, v in res[leg].items()
                                     if isinstance(v, (int, float))}
                    for leg in ("continuous_paged", "static_padded",
-                               "continuous_paged_kernel")}
+                               "continuous_paged_kernel",
+                               "continuous_paged_int8_2x")}
         for _ in range(5):
             rec = runlog.record_run("serve_bench", configs)
         assert rec.get("ledger_path") == led, rec.get("ledger_path")
@@ -453,10 +560,11 @@ def selftest() -> int:
         else:
             os.environ["PADDLE_TPU_RUN_LEDGER"] = prev_env
     print("serve_bench selftest: OK (%.1fs)  %d requests traced; "
-          "kernel leg %s/%s; trace: %s"
+          "kernel leg %s/%s; int8 KV parity err %.2g with 2x pages "
+          "%dB <= fp %dB; trace: %s"
           % (time.perf_counter() - t0, len(digests),
              kleg["decode_kernel"], kleg["decode_kernel_source"],
-             trace_path))
+             i8_err, i8_bytes, fp_bytes, trace_path))
     return 0
 
 
@@ -493,7 +601,7 @@ def main(argv=None) -> int:
 
         configs = {}
         for leg in ("continuous_paged", "static_padded",
-                    "continuous_paged_kernel"):
+                    "continuous_paged_kernel", "continuous_paged_int8_2x"):
             if isinstance(res.get(leg), dict) and "error" not in res[leg]:
                 configs["serve_" + leg] = {
                     k: v for k, v in res[leg].items()
