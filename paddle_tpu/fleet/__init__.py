@@ -1,0 +1,30 @@
+"""paddle_tpu.fleet — fleet serving: a health-aware router over N engine
+replicas.
+
+The layer above one ``serving.ServingEngine``: a bounded-queue router
+(:class:`~.router.Router`) dispatching over N replicas — in-process
+engines for tests/benches, ``python -m paddle_tpu.fleet.worker``
+subprocesses speaking the length-prefixed frame protocol in production
+shape — with health-aware routing, session/prefix affinity, a bounded
+LRU prefix cache of prefilled KV pages, kill-tolerant exactly-once
+request accounting, and per-replica telemetry aggregated into one fleet
+snapshot. See ROADMAP item 2 and tools/fleet_bench.py.
+"""
+
+from . import metrics  # registers every fleet/* instrument
+from .prefix_cache import PrefixCache, PrefixEntry, prefix_key
+from .protocol import FrameReader, read_frame, send_frame
+from .replica import (InProcessReplica, ProcessReplica, SimConfig,
+                      SimEngine, sim_token)
+from .router import (FleetBackpressure, FleetConfig, FleetRequest, Router,
+                     aggregate_telemetry)
+
+__all__ = [
+    "Router", "FleetConfig", "FleetRequest", "FleetBackpressure",
+    "aggregate_telemetry",
+    "PrefixCache", "PrefixEntry", "prefix_key",
+    "InProcessReplica", "ProcessReplica", "SimConfig", "SimEngine",
+    "sim_token",
+    "FrameReader", "read_frame", "send_frame",
+    "metrics",
+]

@@ -1,0 +1,92 @@
+"""fleet/* instruments: the monitor-registry face of the fleet router.
+
+One module owns every ``fleet/*`` name so the router, replicas and the
+prefix cache never race a get-or-create, and tools (``tools/fleet_bench``,
+``tools/dump_metrics --selftest``) can assert the full set exists by
+importing this module alone. Same hot-path contract as serving.metrics:
+module-level handles, a single disabled-branch per call.
+"""
+
+from __future__ import annotations
+
+from ..monitor import metrics as _mx
+
+__all__ = [
+    "SUBMITTED", "ROUTED", "REQUEUED", "COMPLETED", "REJECTED",
+    "DUPLICATE_RESULTS", "QUEUE_DEPTH", "REPLICAS_ALIVE",
+    "REPLICA_RESTARTS", "ROLLING_RESTARTS", "NO_HEALTHY_REPLICA",
+    "REROUTED",
+    "PREFIX_HITS", "PREFIX_MISSES", "PREFIX_INSERTS", "PREFIX_EVICTIONS",
+    "PREFIX_ENTRIES", "PREFIX_PAGES", "PREFIX_TOKENS_REUSED",
+    "PREFIX_POISONED_SKIPPED",
+]
+
+SUBMITTED = _mx.counter(
+    "fleet/submitted", help="requests accepted into the router's queue")
+ROUTED = _mx.counter(
+    "fleet/routed", help="request dispatches to a replica (re-dispatches "
+                         "after a requeue count again)")
+REQUEUED = _mx.counter(
+    "fleet/requeued",
+    help="in-flight requests re-queued after their replica was lost "
+         "(crash/SIGKILL) — replayed idempotently by request id")
+COMPLETED = _mx.counter(
+    "fleet/completed", help="requests that reached exactly one terminal "
+                            "state at the router")
+REJECTED = _mx.counter(
+    "fleet/rejected",
+    help="submissions refused at the router (bounded queue full, or the "
+         "router is draining) — typed backpressure, never a silent drop")
+DUPLICATE_RESULTS = _mx.counter(
+    "fleet/duplicate_results",
+    help="late results for an already-terminal request id, ignored "
+         "(the exactly-once accounting absorbed a replay race)")
+QUEUE_DEPTH = _mx.gauge(
+    "fleet/queue_depth", help="requests waiting in the router's queue")
+REPLICAS_ALIVE = _mx.gauge(
+    "fleet/replicas_alive", help="replicas currently alive")
+REPLICA_RESTARTS = _mx.counter(
+    "fleet/replica_restarts",
+    help="replica respawns (after a crash or a rolling-restart drain)")
+ROLLING_RESTARTS = _mx.counter(
+    "fleet/rolling_restarts",
+    help="completed rolling restarts of the whole fleet")
+NO_HEALTHY_REPLICA = _mx.counter(
+    "fleet/no_healthy_replica",
+    help="dispatch attempts deferred because no healthy replica was "
+         "accepting traffic (requests stay queued — degraded replicas "
+         "are drained of NEW traffic, not fed)")
+REROUTED = _mx.counter(
+    "fleet/rerouted",
+    help="requests re-routed to a peer after a replica-side typed "
+         "rejection (draining/backpressure) — never surfaced as a "
+         "terminal rejection")
+
+PREFIX_HITS = _mx.counter(
+    "fleet/prefix_cache/hits",
+    help="prefill requests served from cached prefix KV pages (prefill "
+         "compute skipped for the shared prefix)")
+PREFIX_MISSES = _mx.counter(
+    "fleet/prefix_cache/misses",
+    help="prefill lookups that found no cached prefix")
+PREFIX_INSERTS = _mx.counter(
+    "fleet/prefix_cache/inserts",
+    help="prefix entries inserted (pages donated by a FINISHED request)")
+PREFIX_EVICTIONS = _mx.counter(
+    "fleet/prefix_cache/evictions",
+    help="LRU evictions under page-budget pressure")
+PREFIX_ENTRIES = _mx.gauge(
+    "fleet/prefix_cache/entries", help="live prefix entries")
+PREFIX_PAGES = _mx.gauge(
+    "fleet/prefix_cache/pages_held",
+    help="KV pages owned by the prefix cache (counted by the engine's "
+         "page-accounting invariant)")
+PREFIX_TOKENS_REUSED = _mx.counter(
+    "fleet/prefix_cache/tokens_reused",
+    help="prompt tokens whose prefill compute was skipped via a cached "
+         "prefix")
+PREFIX_POISONED_SKIPPED = _mx.counter(
+    "fleet/prefix_cache/poisoned_skipped",
+    help="cacheable prefixes NOT inserted because their request did not "
+         "FINISH (failed/timed-out pages are never served to a later "
+         "request)")

@@ -1,0 +1,102 @@
+"""Length-prefixed JSON frames: the router <-> worker wire protocol.
+
+One frame = a 4-byte big-endian length followed by that many bytes of
+UTF-8 JSON. Deliberately primitive — the protocol rides anonymous pipes
+(worker stdin/stdout), must survive a SIGKILLed peer mid-frame (the
+reader just sees a torn tail and EOF), and must be decodable by a human
+with ``xxd``. Router->worker ops and worker->router events are plain
+dicts; the op/event vocabulary lives in worker.py/replica.py, not here.
+
+:class:`FrameReader` is the incremental decoder for the non-blocking
+side (the router tails N worker stdouts through a selector): ``feed()``
+pulls whatever bytes the fd has, ``frames()`` yields every complete
+frame buffered so far, and a half-received frame simply stays buffered
+until the next feed.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import struct
+from typing import Any, Iterator, List, Optional
+
+__all__ = ["MAX_FRAME", "send_frame", "read_frame", "FrameReader"]
+
+_HDR = struct.Struct(">I")
+MAX_FRAME = 32 << 20  # one generation result is KBs; 32MB = corrupt stream
+
+
+def send_frame(fp, obj: Any) -> None:
+    """Serialize ``obj`` and write one frame to binary file object ``fp``
+    (flushes — a worker's result must not sit in userspace buffers while
+    the router waits on select)."""
+    data = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    fp.write(_HDR.pack(len(data)) + data)
+    fp.flush()
+
+
+def read_frame(fp) -> Optional[Any]:
+    """Blocking read of one frame from binary file object ``fp``; None on
+    a clean EOF at a frame boundary. A torn frame (EOF mid-body — the
+    peer died mid-write) also returns None: the caller treats both as
+    "peer gone", which is the only honest reading of either."""
+    hdr = fp.read(_HDR.size)
+    if not hdr or len(hdr) < _HDR.size:
+        return None
+    (n,) = _HDR.unpack(hdr)
+    if n > MAX_FRAME:
+        raise ValueError("frame length %d exceeds MAX_FRAME" % n)
+    body = fp.read(n)
+    if body is None or len(body) < n:
+        return None
+    return json.loads(body.decode("utf-8"))
+
+
+class FrameReader:
+    """Incremental frame decoder over a (typically non-blocking) fd."""
+
+    def __init__(self, fd: int):
+        self.fd = fd
+        self._buf = bytearray()
+        self.eof = False
+
+    def feed(self) -> int:
+        """Drain whatever the fd has right now into the buffer; returns
+        bytes read. Sets ``eof`` when the peer closed (or died)."""
+        total = 0
+        while True:
+            try:
+                chunk = os.read(self.fd, 65536)
+            except BlockingIOError:
+                break
+            except OSError as e:  # EIO from a dead pty counts as EOF
+                if e.errno == errno.EAGAIN:
+                    break
+                self.eof = True
+                break
+            if not chunk:
+                self.eof = True
+                break
+            self._buf.extend(chunk)
+            total += len(chunk)
+        return total
+
+    def frames(self) -> Iterator[Any]:
+        """Yield every complete frame currently buffered (a torn tail
+        stays buffered; after ``eof`` it is unrecoverable and ignored)."""
+        while len(self._buf) >= _HDR.size:
+            (n,) = _HDR.unpack(bytes(self._buf[:_HDR.size]))
+            if n > MAX_FRAME:
+                raise ValueError("frame length %d exceeds MAX_FRAME" % n)
+            if len(self._buf) < _HDR.size + n:
+                return
+            body = bytes(self._buf[_HDR.size:_HDR.size + n])
+            del self._buf[:_HDR.size + n]
+            yield json.loads(body.decode("utf-8"))
+
+    def drain(self) -> List[Any]:
+        """feed() + collect frames() — the router's per-tick pump."""
+        self.feed()
+        return list(self.frames())

@@ -1,0 +1,455 @@
+"""Replica wrappers: the uniform surface the router dispatches over.
+
+Three concrete replicas behind one duck-typed contract (``submit(rdoc)``
+/ ``poll() -> events`` / ``health()`` / ``drain()`` / ``kill()`` /
+``alive``):
+
+* :class:`InProcessReplica` — wraps an engine object living in the
+  router's process (a real ``serving.ServingEngine`` or a
+  :class:`SimEngine`). The test/bench mode: no pipes, no pickling,
+  deterministic pumping.
+* :class:`ProcessReplica` — a ``python -m paddle_tpu.fleet.worker``
+  subprocess speaking the length-prefixed frame protocol over its
+  stdin/stdout. The production shape: SIGKILLing it is a real kill, and
+  the router's only view of its death is EOF/exit — exactly what the
+  crash-tolerance drill needs to exercise.
+* :class:`SimEngine` — a device-bound engine model: each step sleeps
+  ``step_ms`` (the host-blocks-on-accelerator regime — on a TPU replica
+  the host waits on the device, it does not compute) and advances every
+  running slot one deterministic token. Sim tokens are a pure function
+  of (seed, absolute position) like the real engine's sampler, so
+  requeue-replay bit-identity holds by the same mechanism. This is what
+  makes router/protocol QPS scaling honestly measurable on a 1-core CI
+  host: replicas overlap their device waits, not Python compute.
+
+Events (worker -> router), all plain dicts with an ``ev`` key:
+``ready``/``result``/``health``/``drained``/``stats``. A ``result``
+carries the fleet request id, terminal ``state`` (finished/failed/
+timeout — or ``rejected`` with a ``kind`` of draining/backpressure,
+which the router treats as re-routable, never terminal), ``tokens`` and
+``error``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from ..serving.request import (FAILED, FINISHED, REJECTED, BackpressureError,
+                               DrainingError, Request)
+from .protocol import FrameReader, send_frame
+
+__all__ = ["SimConfig", "SimEngine", "InProcessReplica", "ProcessReplica",
+           "sim_token"]
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def sim_token(seed: int, pos: int, vocab: int) -> int:
+    """The sim decoder's next token: a stable hash of (seed, absolute
+    position) — the same keying shape as the real engine's device-side
+    sampler (fold_in(PRNGKey(seed), position)), so a replayed request
+    regenerates the identical stream on any replica, by construction."""
+    h = hashlib.sha1(b"%d:%d" % (int(seed), int(pos))).digest()
+    return int.from_bytes(h[:4], "big") % max(1, int(vocab))
+
+
+class SimConfig:
+    """Geometry + the modeled device latency of one sim replica."""
+
+    def __init__(self, slots: int = 4, step_ms: float = 0.0,
+                 vocab: int = 256, max_queue: int = 1024,
+                 drain_timeout_s: float = 30.0):
+        self.slots = int(slots)
+        self.step_ms = float(step_ms)
+        self.vocab = int(vocab)
+        self.max_queue = int(max_queue)
+        self.drain_timeout_s = float(drain_timeout_s)
+
+
+class SimEngine:
+    """Engine-shaped simulator: the ServingEngine slice the fleet layer
+    drives (submit/step/idle/health/drain/request_drain/close), minus the
+    device. Used in-process for router unit tests and as the worker's
+    ``"engine": "sim"`` mode for protocol-scaling benches."""
+
+    def __init__(self, config: Optional[SimConfig] = None):
+        self.cfg = config or SimConfig()
+        self._queue: List[Request] = []
+        self._running: List[Request] = []
+        self._draining = False
+        self._closed = False
+        self._drain_active = False
+        self.last_drain: Optional[dict] = None
+        self.force_degraded = False  # tests flip this to exercise routing
+        self.steps = 0
+
+    # -- the engine contract --------------------------------------------------
+    def submit(self, prompt, max_new_tokens, deadline_s=None,
+               temperature=0.0, top_k=0, seed=None) -> Request:
+        if self._draining:
+            raise DrainingError("sim engine is draining")
+        if len(self._queue) >= self.cfg.max_queue:
+            raise BackpressureError("sim queue full")
+        req = Request(prompt, max_new_tokens, deadline_s=deadline_s,
+                      temperature=temperature, top_k=top_k, seed=seed)
+        self._queue.append(req)
+        return req
+
+    def idle(self) -> bool:
+        return not self._queue and not self._running
+
+    def _emit(self, req: Request) -> None:
+        pos = req.prompt_len - 1 + len(req.tokens_out)
+        req.tokens_out.append(sim_token(req.seed, pos, self.cfg.vocab))
+
+    def step(self) -> List[Request]:
+        """One sim cycle: admit into free slots (first token emitted at
+        admission, like prefill), block ``step_ms`` on the modeled device,
+        advance every running request one token."""
+        finished: List[Request] = []
+        while self._queue and len(self._running) < self.cfg.slots:
+            req = self._queue.pop(0)
+            req.state = "running"
+            req.admitted_t = time.perf_counter()
+            self._emit(req)
+            req.first_token_t = time.perf_counter()
+            self._running.append(req)
+        if not self._running:
+            return finished
+        if self.cfg.step_ms > 0:
+            time.sleep(self.cfg.step_ms / 1e3)
+        self.steps += 1
+        still: List[Request] = []
+        for req in self._running:
+            if len(req.tokens_out) < req.max_new_tokens:
+                self._emit(req)
+            if len(req.tokens_out) >= req.max_new_tokens:
+                req.state = FINISHED
+                req.finished_t = time.perf_counter()
+                finished.append(req)
+            else:
+                still.append(req)
+        self._running = still
+        return finished
+
+    def health(self) -> dict:
+        return {"status": "degraded" if self.force_degraded else "ok",
+                "queued": len(self._queue), "running": len(self._running),
+                "consecutive_failures": 0, "faults_absorbed": 0,
+                "last_error": None, "page_accounting_ok": True}
+
+    def request_drain(self) -> None:
+        self._draining = True
+
+    def drain(self, timeout_s: Optional[float] = None) -> dict:
+        """Same contract (and re-entrancy discipline) as the real engine's
+        drain: shed queued as REJECTED, finish running, idempotent."""
+        if self.last_drain is not None:
+            return self.last_drain
+        if self._drain_active:
+            return {"finished": 0, "timed_out": 0, "failed": 0,
+                    "rejected": 0, "nested": True}
+        self._drain_active = True
+        try:
+            if timeout_s is None:
+                timeout_s = self.cfg.drain_timeout_s
+            self._draining = True
+            summary = {"finished": 0, "timed_out": 0, "failed": 0,
+                       "rejected": 0}
+            for req in self._queue:
+                req.state = REJECTED
+                req.finished_t = time.perf_counter()
+                summary["rejected"] += 1
+            self._queue = []
+            deadline = time.monotonic() + timeout_s
+            while self._running and time.monotonic() < deadline:
+                summary["finished"] += len(self.step())
+            for req in self._running:
+                req.state = "timeout"
+                summary["timed_out"] += 1
+            self._running = []
+            self.last_drain = summary
+            self.close()
+            return summary
+        finally:
+            self._drain_active = False
+
+    def close(self) -> None:
+        self._closed = True
+
+    def stats(self) -> dict:
+        return {"layout": "sim", "queued": len(self._queue),
+                "running": len(self._running), "steps": self.steps,
+                "step_ms": self.cfg.step_ms, "slots": self.cfg.slots}
+
+
+def _engine_idle(engine) -> bool:
+    if hasattr(engine, "idle"):
+        return engine.idle()
+    return engine.scheduler.idle()
+
+
+class InProcessReplica:
+    """A replica living in the router's process. ``poll()`` pumps the
+    engine one step when it has work — the router's pump loop IS the
+    engine's drive loop in this mode."""
+
+    kind = "inprocess"
+
+    def __init__(self, engine, index: int = 0):
+        self.engine = engine
+        self.index = int(index)
+        self.name = "replica-%d" % self.index
+        self.accepting = True
+        self.alive = True
+        self.inflight: Dict[int, dict] = {}   # fleet id -> request doc
+        self._by_req: Dict[int, int] = {}     # engine Request.id -> fleet id
+        self._requests: Dict[int, Request] = {}  # engine Request.id -> obj
+        self._events: List[dict] = []
+
+    def submit(self, rdoc: dict) -> None:
+        try:
+            req = self.engine.submit(
+                rdoc["prompt"], rdoc["max_new_tokens"],
+                deadline_s=rdoc.get("deadline_s"),
+                temperature=rdoc.get("temperature", 0.0),
+                top_k=rdoc.get("top_k", 0), seed=rdoc.get("seed"))
+        except DrainingError:
+            self._events.append({"ev": "result", "id": rdoc["id"],
+                                 "state": REJECTED, "kind": "draining"})
+            return
+        except BackpressureError:
+            self._events.append({"ev": "result", "id": rdoc["id"],
+                                 "state": REJECTED, "kind": "backpressure"})
+            return
+        except ValueError as e:  # never servable at this geometry: terminal
+            self._events.append({"ev": "result", "id": rdoc["id"],
+                                 "state": FAILED, "tokens": [],
+                                 "error": str(e)})
+            return
+        self.inflight[rdoc["id"]] = rdoc
+        self._by_req[req.id] = rdoc["id"]
+        self._requests[req.id] = req
+
+    def _result(self, req: Request) -> Optional[dict]:
+        fid = self._by_req.pop(req.id, None)
+        self._requests.pop(req.id, None)
+        if fid is None:
+            return None
+        self.inflight.pop(fid, None)
+        return {"ev": "result", "id": fid, "state": req.state,
+                "tokens": list(req.tokens_out), "error": req.error}
+
+    def poll(self) -> List[dict]:
+        evs, self._events = self._events, []  # drain events outlive alive
+        if self.alive and not _engine_idle(self.engine):
+            for req in self.engine.step():
+                r = self._result(req)
+                if r is not None:
+                    evs.append(r)
+        return evs
+
+    def health(self) -> dict:
+        if not self.alive:
+            return {"status": "dead"}
+        return self.engine.health()
+
+    def drain(self, timeout_s: Optional[float] = None) -> dict:
+        """Graceful stop: the engine finishes in-flight work and sheds its
+        queue; every tracked request's terminal state is reported as a
+        normal result event (shed ones come back ``rejected`` so the
+        router re-routes them — never a terminal rejection)."""
+        summary = self.engine.drain(timeout_s)
+        # every still-tracked request now has a terminal state on the
+        # Request object the engine handed back at submit; report each as
+        # a normal result event. Shed ones surface ``rejected`` with
+        # kind=draining so the router re-routes them (never terminal).
+        for rid in list(self._by_req):
+            fid = self._by_req.pop(rid)
+            req = self._requests.pop(rid, None)
+            self.inflight.pop(fid, None)
+            if req is None:
+                continue
+            state = req.state if req.state != "running" else "timeout"
+            ev = {"ev": "result", "id": fid, "state": state,
+                  "tokens": list(req.tokens_out), "error": req.error}
+            if state == REJECTED:
+                ev["kind"] = "draining"
+            self._events.append(ev)
+        self.accepting = False
+        self.alive = False  # a drained engine is closed; respawn to reuse
+        return summary
+
+    def kill(self) -> None:
+        """The in-process analog of SIGKILL: the engine vanishes with its
+        in-flight work. ``inflight`` keeps the lost request docs for the
+        router's requeue path."""
+        self.alive = False
+        self.accepting = False
+        try:
+            self.engine.close()
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        if self.alive:
+            try:
+                self.engine.close()
+            except Exception:
+                pass
+        self.alive = False
+
+
+class ProcessReplica:
+    """One ``python -m paddle_tpu.fleet.worker`` subprocess. The router
+    writes op frames to its stdin and tails event frames from its stdout
+    (non-blocking; pumped by ``poll()``). Death — clean exit or SIGKILL —
+    surfaces as EOF/exit, flips ``alive`` False, and leaves ``inflight``
+    holding exactly the request docs the router must requeue."""
+
+    kind = "process"
+
+    def __init__(self, spec: dict, index: int = 0,
+                 telemetry_dir: Optional[str] = None,
+                 ready_timeout_s: float = 120.0):
+        self.spec = dict(spec)
+        self.index = int(index)
+        self.name = "replica-%d" % self.index
+        self.accepting = True
+        self.inflight: Dict[int, dict] = {}
+        self._events: List[dict] = []
+        self._dead = False
+        self.pid: Optional[int] = None
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+        if telemetry_dir:
+            os.makedirs(telemetry_dir, exist_ok=True)
+            env["PADDLE_TPU_TELEMETRY_DIR"] = telemetry_dir
+        else:
+            # never let N workers share the parent's ring dir by accident
+            env.pop("PADDLE_TPU_TELEMETRY_DIR", None)
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.fleet.worker"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env)
+        os.set_blocking(self.proc.stdout.fileno(), False)
+        self.reader = FrameReader(self.proc.stdout.fileno())
+        send_frame(self.proc.stdin, {"op": "spec", "spec": self.spec})
+        self._wait_ready(ready_timeout_s)
+
+    def _wait_ready(self, timeout_s: float) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            for ev in self.reader.drain():
+                if ev.get("ev") == "ready":
+                    self.pid = ev.get("pid")
+                    return
+                self._events.append(ev)
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    "fleet worker %d died during startup (rc=%s)"
+                    % (self.index, self.proc.returncode))
+            time.sleep(0.01)
+        self.kill()
+        raise RuntimeError("fleet worker %d not ready after %.0fs"
+                           % (self.index, timeout_s))
+
+    @property
+    def alive(self) -> bool:
+        return not self._dead
+
+    def _send(self, op: dict) -> bool:
+        if self._dead:
+            return False
+        try:
+            send_frame(self.proc.stdin, op)
+            return True
+        except (BrokenPipeError, OSError):
+            return False  # poll() will observe the death and requeue
+
+    def submit(self, rdoc: dict) -> None:
+        # track BEFORE sending: if the pipe breaks mid-write the request
+        # is in inflight and the death path requeues it — never dropped
+        self.inflight[rdoc["id"]] = rdoc
+        self._send(dict(rdoc, op="submit"))
+
+    def poll(self) -> List[dict]:
+        evs, self._events = self._events, []  # drain events outlive alive
+        if self._dead:
+            return evs
+        evs.extend(self.reader.drain())
+        for ev in evs:
+            if ev.get("ev") == "result":
+                self.inflight.pop(ev.get("id"), None)
+        if self.reader.eof or self.proc.poll() is not None:
+            # peer gone: any frames already buffered were just returned;
+            # what remains in inflight is the router's requeue set
+            self._dead = True
+            try:
+                self.proc.wait(timeout=5)
+            except Exception:
+                pass
+        return evs
+
+    def health(self) -> dict:
+        """Last health event wins; this just asks for a fresh one (the
+        answer arrives on a later poll). Returns nothing synchronous —
+        the router caches health from the event stream."""
+        self._send({"op": "health"})
+        return {}
+
+    def drain(self, timeout_s: Optional[float] = None) -> dict:
+        """Graceful stop: the worker drains its engine, reports every
+        tracked request's terminal state, emits ``drained`` and exits.
+        Result events collected here surface through the next poll()."""
+        self.accepting = False
+        if not self._send({"op": "drain", "timeout_s": timeout_s}):
+            return {}
+        summary: dict = {}
+        deadline = time.monotonic() + (timeout_s or 30.0) + 10.0
+        while time.monotonic() < deadline:
+            for ev in self.reader.drain():
+                if ev.get("ev") == "drained":
+                    summary = ev.get("summary", {})
+                else:
+                    if ev.get("ev") == "result":
+                        self.inflight.pop(ev.get("id"), None)
+                    self._events.append(ev)
+            if summary:
+                break
+            if self.proc.poll() is not None and self.reader.eof:
+                break
+            time.sleep(0.005)
+        try:
+            self.proc.wait(timeout=10)
+        except Exception:
+            self.proc.kill()
+        self._dead = True
+        return summary
+
+    def kill(self) -> None:
+        """SIGKILL — the crash drill's hammer. No goodbye frames: the
+        router finds out the same way it would in production (EOF)."""
+        try:
+            self.proc.kill()
+        except Exception:
+            pass
+        try:
+            self.proc.wait(timeout=10)
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        if not self._dead:
+            self._send({"op": "shutdown"})
+            try:
+                self.proc.wait(timeout=10)
+            except Exception:
+                self.kill()
+            self._dead = True

@@ -1,0 +1,536 @@
+"""Health-aware fleet router: one bounded queue over N engine replicas.
+
+The front door of the serving fleet. The router owns a bounded request
+queue and a set of replicas (:class:`~.replica.InProcessReplica` for
+tests/benches, :class:`~.replica.ProcessReplica` workers in production
+shape) and guarantees, through every failure mode it knows about:
+
+* **exactly-once terminal accounting** — every accepted request reaches
+  exactly ONE terminal state (finished/failed/timeout/rejected), recorded
+  on its :class:`FleetRequest`. Late/duplicate results after a requeue
+  race are absorbed (``fleet/duplicate_results``), never double-counted;
+* **crash tolerance** — a replica that dies (SIGKILL, OOM) is detected via
+  its pipe/exit status; its in-flight requests requeue idempotently by
+  fleet id (``fleet/requeued``) and replay bit-identically: the router
+  pins every request's seed at submission, and sampling is keyed (seed,
+  absolute position), so a retried stream equals the unkilled twin's;
+* **health-aware dispatch** — replicas whose ``health()`` reports
+  ``degraded`` (SLO breach, absorbed faults) are drained of NEW traffic
+  but not killed; with no healthy replica accepting, requests stay queued
+  (``fleet/no_healthy_replica``) rather than failing;
+* **graceful rollout** — :meth:`rolling_restart` = per replica
+  ``drain(timeout_s)`` → respawn. Requests the drain sheds come back as
+  typed ``draining`` rejections and are re-routed to peers — zero
+  rejected-by-bug.
+
+Affinity: ``affinity="prefix"`` routes by a stable hash of the first
+``affinity_tokens`` prompt tokens, so one conversation/system-prompt
+cohort lands on one replica and its KV pages (and prefix-cache entries)
+stay hot there; ``"round_robin"`` is the reference spread.
+
+The router is single-threaded by design: :meth:`pump` is the event loop
+tick (poll replicas → account results → detect deaths → dispatch), and
+everything else composes on it. No locks, no callback hell — the same
+drive-loop shape as ``ServingEngine.step``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Sequence
+
+from ..serving.request import FAILED, FINISHED, REJECTED, TIMEOUT
+from . import metrics as _fm
+from .prefix_cache import prefix_key
+from .replica import InProcessReplica, ProcessReplica
+
+__all__ = ["FleetConfig", "FleetRequest", "FleetBackpressure", "Router",
+           "aggregate_telemetry"]
+
+_TERMINAL = (FINISHED, FAILED, TIMEOUT, REJECTED)
+
+
+class FleetBackpressure(RuntimeError):
+    """The router's bounded queue is full (or it is draining): typed
+    shed-or-retry, mirroring serving.BackpressureError one level up."""
+
+
+class FleetRequest:
+    """One request as the ROUTER accounts it. The id is router-assigned
+    and stable across requeues (the idempotency key); the seed is ALWAYS
+    pinned at submission — derived deterministically from the id when the
+    caller passes None — so a replay after a replica loss regenerates the
+    identical sampled stream."""
+
+    __slots__ = ("id", "prompt", "max_new_tokens", "deadline_s",
+                 "temperature", "top_k", "seed", "state", "tokens", "error",
+                 "attempts", "last_replica", "submitted_t", "finished_t")
+
+    def __init__(self, rid: int, prompt: Sequence[int], max_new_tokens: int,
+                 deadline_s: Optional[float] = None, temperature: float = 0.0,
+                 top_k: int = 0, seed: Optional[int] = None):
+        self.id = int(rid)
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline_s = deadline_s
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        # never let a replica pick an id-derived seed: engine-local request
+        # ids differ between the first attempt and a requeued replay
+        self.seed = (int(seed) if seed is not None
+                     else (self.id * 1000003 + 0x5EED) & 0x7FFFFFFF)
+        self.state = "queued"
+        self.tokens: List[int] = []
+        self.error: Optional[str] = None
+        self.attempts = 0
+        self.last_replica: Optional[int] = None
+        self.submitted_t = time.perf_counter()
+        self.finished_t: Optional[float] = None
+
+    @property
+    def terminal(self) -> bool:
+        return self.state in _TERMINAL
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.finished_t is None:
+            return None
+        return self.finished_t - self.submitted_t
+
+    def doc(self) -> dict:
+        """The wire/replica form of this request."""
+        return {"id": self.id, "prompt": self.prompt,
+                "max_new_tokens": self.max_new_tokens,
+                "deadline_s": self.deadline_s,
+                "temperature": self.temperature, "top_k": self.top_k,
+                "seed": self.seed}
+
+    def __repr__(self):
+        return ("FleetRequest(id=%d, state=%s, out=%d, attempts=%d)"
+                % (self.id, self.state, len(self.tokens), self.attempts))
+
+
+class FleetConfig:
+    """Router geometry + policy.
+
+    ``replicas``: replica count, or ``"auto"`` to consult the autotuned
+    config table (tune kernel ``fleet.router``; falls back to 2).
+    ``mode``: ``"inprocess"`` (requires ``engine_factory``, a callable
+    ``index -> engine``) or ``"process"`` (requires ``engine_spec``, the
+    worker spec dict — see fleet.worker). ``affinity``: ``"prefix"`` or
+    ``"round_robin"``; ``affinity_tokens`` is the prefix-hash window.
+    ``max_outstanding`` caps dispatched-but-unresolved requests per
+    replica (bounds the requeue set a crash can strand). ``requeue_limit``
+    bounds replays per request before it terminally FAILs ("replica
+    lost"). ``telemetry_base``: per-replica telemetry ring dirs are
+    created under it (``replica_<i>/``) in process mode.
+    """
+
+    def __init__(self, replicas=2, mode: str = "inprocess",
+                 affinity: str = "prefix", affinity_tokens: int = 16,
+                 max_queue: int = 1024, max_outstanding: int = 16,
+                 requeue_limit: int = 2, drain_timeout_s: float = 30.0,
+                 engine_factory: Optional[Callable] = None,
+                 engine_spec: Optional[dict] = None,
+                 auto_restart: bool = True,
+                 telemetry_base: Optional[str] = None,
+                 health_every: int = 16):
+        if mode not in ("inprocess", "process"):
+            raise ValueError("mode must be 'inprocess' or 'process'")
+        if affinity not in ("prefix", "round_robin"):
+            raise ValueError("affinity must be 'prefix' or 'round_robin'")
+        self.replicas_source = "explicit"
+        if replicas in (None, "auto"):
+            replicas, affinity_cfg, self.replicas_source = \
+                self._tuned_router(affinity)
+            affinity = affinity_cfg
+        self.replicas = max(1, int(replicas))
+        self.mode = mode
+        self.affinity = affinity
+        self.affinity_tokens = max(1, int(affinity_tokens))
+        self.max_queue = int(max_queue)
+        self.max_outstanding = max(1, int(max_outstanding))
+        self.requeue_limit = max(0, int(requeue_limit))
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.engine_factory = engine_factory
+        self.engine_spec = engine_spec
+        self.auto_restart = bool(auto_restart)
+        self.telemetry_base = telemetry_base
+        self.health_every = max(1, int(health_every))
+        if mode == "inprocess" and engine_factory is None:
+            raise ValueError("inprocess mode needs engine_factory")
+        if mode == "process" and engine_spec is None:
+            raise ValueError("process mode needs engine_spec")
+
+    @staticmethod
+    def _tuned_router(affinity_default: str):
+        """(replicas, affinity, source) from the tune table; a safe
+        (2, default-affinity, "default") on any failure — the fleet must
+        come up with no table on disk."""
+        try:
+            from .. import tune
+
+            cfg, src = tune.resolve_fleet_router()
+            return (int(cfg.get("replicas", 2)),
+                    cfg.get("affinity", affinity_default), src)
+        except Exception:
+            return 2, affinity_default, "default"
+
+
+class Router:
+    """See module docstring. Lifecycle: construct (spawns replicas) →
+    ``submit``/``pump`` (or ``wait_all``) → ``drain``/``close``."""
+
+    def __init__(self, config: FleetConfig):
+        self.cfg = config
+        self._queue: Deque[FleetRequest] = deque()
+        self._requests: Dict[int, FleetRequest] = {}
+        self._next_id = 0
+        self._rr = 0          # round-robin cursor
+        self._ticks = 0
+        self._draining = False
+        self._closed = False
+        self._t0 = time.perf_counter()
+        self._health: Dict[int, dict] = {}       # replica index -> last doc
+        self._rep_done: Dict[int, int] = {}      # replica index -> completed
+        self._rep_lat: Dict[int, List[float]] = {}
+        self._replicas = [self._spawn(i) for i in range(self.cfg.replicas)]
+        _fm.REPLICAS_ALIVE.set(len(self._replicas))
+
+    # -- replica lifecycle ----------------------------------------------------
+    def _spawn(self, index: int):
+        self._health[index] = {"status": "ok"}
+        self._rep_done.setdefault(index, 0)
+        self._rep_lat.setdefault(index, [])
+        if self.cfg.mode == "inprocess":
+            return InProcessReplica(self.cfg.engine_factory(index), index)
+        tdir = None
+        if self.cfg.telemetry_base:
+            tdir = os.path.join(self.cfg.telemetry_base,
+                                "replica_%d" % index)
+        return ProcessReplica(self.cfg.engine_spec, index,
+                              telemetry_dir=tdir)
+
+    def _respawn(self, index: int) -> None:
+        self._replicas[index] = self._spawn(index)
+        _fm.REPLICA_RESTARTS.inc()
+
+    # -- submission -----------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               deadline_s: Optional[float] = None, temperature: float = 0.0,
+               top_k: int = 0, seed: Optional[int] = None) -> FleetRequest:
+        """Accept a request into the bounded queue. Raises
+        :class:`FleetBackpressure` (typed, accounted) when full or
+        draining — the router never silently drops."""
+        if self._closed or self._draining:
+            _fm.REJECTED.inc()
+            raise FleetBackpressure("router is draining/closed")
+        if len(self._queue) >= self.cfg.max_queue:
+            _fm.REJECTED.inc()
+            raise FleetBackpressure(
+                "fleet queue full (%d)" % self.cfg.max_queue)
+        fr = FleetRequest(self._next_id, prompt, max_new_tokens,
+                          deadline_s=deadline_s, temperature=temperature,
+                          top_k=top_k, seed=seed)
+        self._next_id += 1
+        self._requests[fr.id] = fr
+        self._queue.append(fr)
+        _fm.SUBMITTED.inc()
+        _fm.QUEUE_DEPTH.set(len(self._queue))
+        return fr
+
+    # -- accounting -----------------------------------------------------------
+    def _finalize(self, fr: FleetRequest, state: str,
+                  tokens: Optional[List[int]] = None,
+                  error: Optional[str] = None) -> None:
+        """THE exactly-once funnel: every terminal outcome lands here, and
+        an already-terminal request absorbs the duplicate instead of
+        flipping state (a SIGKILL race can produce both a late result and
+        a requeued completion — first one wins, deterministically)."""
+        if fr.terminal:
+            _fm.DUPLICATE_RESULTS.inc()
+            return
+        fr.state = state
+        if tokens is not None:
+            fr.tokens = list(tokens)
+        fr.error = error
+        fr.finished_t = time.perf_counter()
+        _fm.COMPLETED.inc()
+        if fr.last_replica is not None:
+            self._rep_done[fr.last_replica] = \
+                self._rep_done.get(fr.last_replica, 0) + 1
+            self._rep_lat.setdefault(fr.last_replica, []).append(
+                (fr.finished_t - fr.submitted_t) * 1e3)
+
+    def _requeue(self, fr: FleetRequest, why: str) -> None:
+        if fr.terminal:
+            return
+        fr.attempts += 1
+        if fr.attempts > self.cfg.requeue_limit:
+            self._finalize(fr, FAILED,
+                           error="replica lost %d times (%s)"
+                                 % (fr.attempts, why))
+            return
+        fr.state = "queued"
+        self._queue.appendleft(fr)  # retries go to the head: oldest first
+
+    def _handle_event(self, rep, ev: dict) -> None:
+        kind = ev.get("ev")
+        if kind == "health":
+            self._health[rep.index] = ev.get("health", {"status": "ok"})
+            return
+        if kind != "result":
+            return
+        fr = self._requests.get(ev.get("id"))
+        if fr is None:
+            return
+        state = ev.get("state")
+        if state == REJECTED and ev.get("kind") in ("draining",
+                                                    "backpressure"):
+            # replica-side typed shed: route to a peer, never terminal
+            _fm.REROUTED.inc()
+            self._requeue_reroute(fr)
+            return
+        self._finalize(fr, state, ev.get("tokens"), ev.get("error"))
+
+    def _requeue_reroute(self, fr: FleetRequest) -> None:
+        """A typed reroute (peer draining/backpressured) does not count
+        against the requeue budget — nothing was lost, only refused."""
+        if fr.terminal:
+            return
+        fr.state = "queued"
+        self._queue.appendleft(fr)
+
+    # -- the event-loop tick --------------------------------------------------
+    def pump(self) -> int:
+        """One router cycle: poll replicas (pumps in-process engines one
+        step), account events, detect/recover deaths, dispatch the queue.
+        Returns the number of requests still unresolved."""
+        self._ticks += 1
+        for rep in list(self._replicas):
+            for ev in rep.poll():
+                self._handle_event(rep, ev)
+        for i, rep in enumerate(self._replicas):
+            if not rep.alive:
+                lost = list(rep.inflight.values())
+                rep.inflight.clear()
+                for rdoc in lost:
+                    fr = self._requests.get(rdoc["id"])
+                    if fr is not None and not fr.terminal:
+                        _fm.REQUEUED.inc()
+                        self._requeue(fr, "replica %d died" % i)
+                if self.cfg.auto_restart and not self._draining \
+                        and not self._closed:
+                    self._respawn(i)
+        if self.cfg.mode == "process" \
+                and self._ticks % self.cfg.health_every == 0:
+            for rep in self._replicas:
+                if rep.alive:
+                    rep.health()  # answer arrives as a health event
+        self._dispatch()
+        _fm.QUEUE_DEPTH.set(len(self._queue))
+        _fm.REPLICAS_ALIVE.set(sum(1 for r in self._replicas if r.alive))
+        return sum(1 for fr in self._requests.values() if not fr.terminal)
+
+    def _replica_healthy(self, rep) -> bool:
+        if not rep.alive or not rep.accepting:
+            return False
+        if rep.kind == "inprocess":
+            h = rep.health()
+        else:
+            h = self._health.get(rep.index, {"status": "ok"})
+        return h.get("status", "ok") == "ok"
+
+    def _pick_replica(self, fr: FleetRequest):
+        n = len(self._replicas)
+        if self.cfg.affinity == "prefix":
+            window = fr.prompt[:self.cfg.affinity_tokens]
+            start = int(prefix_key(window)[:8], 16) % n
+        else:
+            start = self._rr % n
+            self._rr += 1
+        for off in range(n):
+            rep = self._replicas[(start + off) % n]
+            if self._replica_healthy(rep) \
+                    and len(rep.inflight) < self.cfg.max_outstanding:
+                return rep
+        return None
+
+    def _dispatch(self) -> None:
+        stuck = False
+        while self._queue and not stuck:
+            fr = self._queue[0]
+            if fr.terminal:  # finalized while queued (router drain race)
+                self._queue.popleft()
+                continue
+            rep = self._pick_replica(fr)
+            if rep is None:
+                _fm.NO_HEALTHY_REPLICA.inc()
+                stuck = True  # stays queued; degraded peers get no traffic
+                break
+            self._queue.popleft()
+            fr.state = "dispatched"
+            fr.last_replica = rep.index
+            rep.submit(fr.doc())
+            _fm.ROUTED.inc()
+
+    def wait_all(self, timeout_s: float = 60.0,
+                 idle_sleep_s: float = 0.002) -> bool:
+        """Pump until every accepted request is terminal (True) or the
+        timeout passes (False)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.pump() == 0:
+                return True
+            if self.cfg.mode == "process":
+                time.sleep(idle_sleep_s)
+        return self.pump() == 0
+
+    # -- lifecycle ------------------------------------------------------------
+    def rolling_restart(self, timeout_s: Optional[float] = None) -> dict:
+        """Zero-downtime rollout: one replica at a time, stop its new
+        traffic, ``drain(timeout_s)`` (in-flight finishes; engine-queued
+        work is shed as typed ``draining`` rejections that re-route to
+        peers), respawn, move on. Traffic keeps flowing through the
+        others for the whole pass."""
+        if timeout_s is None:
+            timeout_s = self.cfg.drain_timeout_s
+        summaries = {}
+        for i in range(len(self._replicas)):
+            rep = self._replicas[i]
+            rep.accepting = False
+            if rep.alive:
+                summaries[rep.name] = rep.drain(timeout_s)
+            for ev in rep.poll():  # drain's result events (incl. sheds)
+                self._handle_event(rep, ev)
+            # anything the drain could not resolve is a lost in-flight set
+            lost = list(rep.inflight.values())
+            rep.inflight.clear()
+            for rdoc in lost:
+                fr = self._requests.get(rdoc["id"])
+                if fr is not None and not fr.terminal:
+                    _fm.REQUEUED.inc()
+                    self._requeue(fr, "rolling restart of replica %d" % i)
+            self._respawn(i)
+            self.pump()  # rerouted work lands on peers before the next leg
+        _fm.ROLLING_RESTARTS.inc()
+        return summaries
+
+    def drain(self, timeout_s: Optional[float] = None) -> dict:
+        """Fleet-wide graceful stop: no new submissions, finish what can
+        finish within the budget, account everything else (queued work
+        sheds as terminal REJECTED — typed, counted, never silent)."""
+        if timeout_s is None:
+            timeout_s = self.cfg.drain_timeout_s
+        self._draining = True
+        self.wait_all(timeout_s)
+        for rep in self._replicas:
+            if rep.alive:
+                rep.drain(timeout_s)
+            for ev in rep.poll():
+                self._handle_event(rep, ev)
+        out = {"finished": 0, "failed": 0, "timeout": 0, "rejected": 0}
+        for fr in self._requests.values():
+            if not fr.terminal:
+                _fm.REJECTED.inc()
+                self._finalize(fr, REJECTED, error="router drained")
+            out[fr.state] = out.get(fr.state, 0) + 1
+        self._queue.clear()
+        _fm.QUEUE_DEPTH.set(0)
+        self.close()
+        return out
+
+    def close(self) -> None:
+        """Stop the fleet. Idempotent; replicas still alive are shut down
+        (process workers get a graceful shutdown op, then SIGKILL)."""
+        if self._closed:
+            return
+        self._closed = True
+        for rep in self._replicas:
+            try:
+                rep.close()
+            except Exception:
+                pass
+        _fm.REPLICAS_ALIVE.set(0)
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- introspection --------------------------------------------------------
+    def accounting(self) -> Dict[int, str]:
+        """fleet id -> state for every request ever accepted — the drill's
+        zero-silent-drops ledger."""
+        return {fid: fr.state for fid, fr in self._requests.items()}
+
+    def request(self, fid: int) -> Optional[FleetRequest]:
+        return self._requests.get(fid)
+
+    @staticmethod
+    def _p99(lat_ms: List[float]) -> Optional[float]:
+        if not lat_ms:
+            return None
+        s = sorted(lat_ms)
+        return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+    def snapshot(self) -> dict:
+        """One fleet-wide observability document: router counters,
+        per-replica liveness/health/throughput, and (process mode with a
+        telemetry base) the merged last-sample view of every replica's
+        telemetry ring."""
+        now = time.perf_counter()
+        dt = max(now - self._t0, 1e-9)
+        states: Dict[str, int] = {}
+        for fr in self._requests.values():
+            states[fr.state] = states.get(fr.state, 0) + 1
+        reps = []
+        for rep in self._replicas:
+            idx = rep.index
+            lat = self._rep_lat.get(idx, [])
+            reps.append({
+                "name": rep.name, "alive": rep.alive,
+                "accepting": rep.accepting,
+                "health": (rep.health() if rep.kind == "inprocess"
+                           and rep.alive
+                           else self._health.get(idx, {"status": "ok"})),
+                "inflight": len(rep.inflight),
+                "completed": self._rep_done.get(idx, 0),
+                "qps": round(self._rep_done.get(idx, 0) / dt, 3),
+                "p99_ms": self._p99(lat),
+            })
+        out = {"queue_depth": len(self._queue),
+               "requests": len(self._requests),
+               "states": states,
+               "replicas": reps,
+               "uptime_s": round(dt, 3)}
+        if self.cfg.telemetry_base:
+            out["telemetry"] = aggregate_telemetry(self.cfg.telemetry_base)
+        return out
+
+
+def aggregate_telemetry(base_dir: str) -> dict:
+    """Merge N replicas' telemetry rings (``<base>/replica_<i>/``, each an
+    exporter dir of JSONL ring files) into one fleet view: per replica,
+    the LAST sample of each of its processes. The same files
+    ``tools/dump_metrics --watch dir1,dir2,...`` tails live."""
+    from ..monitor import telemetry as _telemetry
+
+    out: Dict[str, dict] = {}
+    if not base_dir or not os.path.isdir(base_dir):
+        return out
+    for name in sorted(os.listdir(base_dir)):
+        sub = os.path.join(base_dir, name)
+        if not (name.startswith("replica_") and os.path.isdir(sub)):
+            continue
+        try:
+            series = _telemetry.read_series(sub)
+        except Exception:
+            continue
+        if series:
+            last = series[-1]
+            out[name] = {"samples": len(series), "last": last}
+    return out

@@ -1,0 +1,201 @@
+"""Replica worker: one engine in one process, driven over stdin/stdout.
+
+``python -m paddle_tpu.fleet.worker`` is what a :class:`ProcessReplica`
+spawns. The first frame on stdin is the engine spec; everything the
+router needs afterwards rides the frame protocol (protocol.py):
+
+ops (router -> worker)::
+
+    {"op": "spec", "spec": {...}}            # first frame only
+    {"op": "submit", "id": <fleet id>, "prompt": [...],
+     "max_new_tokens": n, "temperature": t, "top_k": k, "seed": s,
+     "deadline_s": d}
+    {"op": "health"}                         # answered by a health event
+    {"op": "drain", "timeout_s": t}          # graceful stop, then exit
+    {"op": "shutdown"}                       # immediate close, then exit
+
+events (worker -> router)::
+
+    {"ev": "ready", "pid": ...}              # spec accepted, engine warm
+    {"ev": "result", "id", "state", "tokens", "error"[, "kind"]}
+    {"ev": "health", "health": {...}}
+    {"ev": "drained", "summary": {...}}      # last frame before exit
+
+The spec is the ISSUE's "engine handle extraction": the serving engine's
+construction knobs, serialized. ``{"engine": "real", "model": {DecoderConfig
+kwargs}, "model_seed": n, "serving": {ServingConfig kwargs}, "warmup": true}``
+builds a DecoderLM + ServingEngine; ``{"engine": "sim", "sim": {SimConfig
+kwargs}}`` builds the device-latency simulator (protocol/scaling benches on
+hosts with no parallel compute to give).
+
+fd hygiene: the frame channel is a dup of fd 1 taken at startup, after
+which fd 1 is pointed at stderr — a stray ``print`` inside jax or user
+code can then never corrupt the frame stream.
+
+Request accounting mirrors InProcessReplica: every submitted id gets
+exactly one result event — typed rejections (draining/backpressure)
+carry ``kind`` so the router re-routes instead of terminating them, and a
+drain reports the terminal state of everything still tracked before the
+``drained`` frame.
+"""
+
+from __future__ import annotations
+
+import os
+import select
+import sys
+import time
+from typing import Dict, Optional
+
+from ..serving.request import (FAILED, REJECTED, BackpressureError,
+                               DrainingError, Request)
+from .protocol import FrameReader, send_frame
+
+__all__ = ["main"]
+
+
+def _build_engine(spec: dict):
+    if spec.get("engine", "real") == "sim":
+        from .replica import SimConfig, SimEngine
+
+        return SimEngine(SimConfig(**spec.get("sim", {})))
+    from ..models.decoder_lm import DecoderConfig, DecoderLM
+    from ..serving.engine import ServingConfig, ServingEngine
+
+    mcfg = DecoderConfig(**spec.get("model", {}))
+    model = DecoderLM(mcfg, seed=int(spec.get("model_seed", 0)))
+    engine = ServingEngine(model, ServingConfig(**spec.get("serving", {})))
+    if spec.get("warmup"):
+        engine.warmup()
+    return engine
+
+
+class _Worker:
+    def __init__(self, chan, engine):
+        self.chan = chan
+        self.engine = engine
+        self._by_req: Dict[int, int] = {}      # engine Request.id -> fleet id
+        self._requests: Dict[int, Request] = {}
+
+    def emit(self, ev: dict) -> None:
+        send_frame(self.chan, ev)
+
+    def _result(self, req: Request) -> None:
+        fid = self._by_req.pop(req.id, None)
+        self._requests.pop(req.id, None)
+        if fid is None:
+            return
+        self.emit({"ev": "result", "id": fid, "state": req.state,
+                   "tokens": list(req.tokens_out), "error": req.error})
+
+    def submit(self, op: dict) -> None:
+        try:
+            req = self.engine.submit(
+                op["prompt"], op["max_new_tokens"],
+                deadline_s=op.get("deadline_s"),
+                temperature=op.get("temperature", 0.0),
+                top_k=op.get("top_k", 0), seed=op.get("seed"))
+        except DrainingError:
+            self.emit({"ev": "result", "id": op["id"], "state": REJECTED,
+                       "kind": "draining"})
+            return
+        except BackpressureError:
+            self.emit({"ev": "result", "id": op["id"], "state": REJECTED,
+                       "kind": "backpressure"})
+            return
+        except ValueError as e:
+            self.emit({"ev": "result", "id": op["id"], "state": FAILED,
+                       "tokens": [], "error": str(e)})
+            return
+        self._by_req[req.id] = op["id"]
+        self._requests[req.id] = req
+
+    def pump(self) -> None:
+        for req in self.engine.step():
+            self._result(req)
+
+    def busy(self) -> bool:
+        if hasattr(self.engine, "idle"):
+            return not self.engine.idle()
+        return not self.engine.scheduler.idle()
+
+    def drain(self, timeout_s: Optional[float]) -> None:
+        summary = self.engine.drain(timeout_s)
+        for rid in list(self._by_req):
+            req = self._requests.pop(rid, None)
+            fid = self._by_req.pop(rid)
+            if req is None:
+                continue
+            state = req.state if req.state != "running" else "timeout"
+            ev = {"ev": "result", "id": fid, "state": state,
+                  "tokens": list(req.tokens_out), "error": req.error}
+            if state == REJECTED:
+                # shed by the drain, not refused by policy: the router
+                # re-routes these to a peer — zero rejected-by-bug
+                ev["kind"] = "draining"
+            self.emit(ev)
+        self.emit({"ev": "drained", "summary": summary})
+
+
+def main() -> int:
+    # claim the frame channel, then point fd 1 at stderr so stray prints
+    # (jax warnings, user hooks) can never tear a frame
+    chan = os.fdopen(os.dup(1), "wb", buffering=0)
+    os.dup2(2, 1)
+    sys.stdout = sys.stderr
+
+    stdin_fd = sys.stdin.fileno()
+    os.set_blocking(stdin_fd, False)
+    reader = FrameReader(stdin_fd)
+
+    spec = None
+    deadline = time.monotonic() + 60.0
+    while spec is None and time.monotonic() < deadline:
+        select.select([stdin_fd], [], [], 1.0)
+        for frame in reader.drain():
+            if frame.get("op") == "spec":
+                spec = frame.get("spec", {})
+                break
+        if reader.eof:
+            return 1
+    if spec is None:
+        return 1
+
+    # the worker process owns its telemetry ring (PADDLE_TPU_TELEMETRY_DIR
+    # is set per-replica by ProcessReplica): sim engines get a series too,
+    # and release() flushes a final partial sample even for short lives
+    from ..monitor import telemetry as _telemetry
+
+    tele = _telemetry.acquire()
+    try:
+        worker = _Worker(chan, _build_engine(spec))
+        worker.emit({"ev": "ready", "pid": os.getpid()})
+
+        while True:
+            timeout = 0.0 if worker.busy() else 0.05
+            select.select([stdin_fd], [], [], timeout)
+            for op in reader.drain():
+                kind = op.get("op")
+                if kind == "submit":
+                    worker.submit(op)
+                elif kind == "health":
+                    worker.emit({"ev": "health",
+                                 "health": worker.engine.health()})
+                elif kind == "drain":
+                    worker.drain(op.get("timeout_s"))
+                    return 0
+                elif kind == "shutdown":
+                    worker.engine.close()
+                    return 0
+            if reader.eof:
+                # router gone: nothing to report results to — close + exit
+                worker.engine.close()
+                return 0
+            if worker.busy():
+                worker.pump()
+    finally:
+        _telemetry.release(tele)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -144,7 +144,8 @@ class ServingConfig:
                  fail_fast: bool = False,
                  slos: Optional[Sequence] = None,
                  drain_timeout_s: float = 30.0,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None,
+                 prefix_cache_pages: int = 0):
         if kv_dtype not in (None, "int8"):
             raise ValueError("kv_dtype must be None or 'int8', got %r"
                              % (kv_dtype,))
@@ -181,6 +182,15 @@ class ServingConfig:
         # (monitor.numerics.kv_scale); otherwise the engine falls back to
         # the fp cache with a vlog warning instead of refusing to serve
         self.kv_dtype = kv_dtype
+        # >0 arms the fleet prefix cache (paged layout only): that many
+        # pool pages may be pinned by cached prompt-prefix KV, LRU-evicted
+        # under pressure. A hit skips the shared prefix's prefill compute
+        # (pages are row-copied, the remainder runs the resume executable).
+        self.prefix_cache_pages = max(0, int(prefix_cache_pages))
+        if self.prefix_cache_pages >= self.num_pages:
+            raise ValueError(
+                "prefix_cache_pages=%d must leave serving pages free "
+                "(num_pages=%d)" % (self.prefix_cache_pages, self.num_pages))
 
     def _tuned_decode_fuse(self):
         """(value, source) from the autotuned config table; (1, "default")
@@ -252,6 +262,15 @@ class ServingEngine:
         self._seed = jnp.zeros((b,), jnp.int32)
         self._prefill_exe: Dict[int, Any] = {}   # bucket -> AOT executable
         self._decode_exe: Dict[int, Any] = {}    # fuse length -> executable
+        self._resume_exe: Dict[int, Any] = {}    # remainder bucket -> exe
+        # fleet prefix cache: host-side index of donated prompt-prefix KV
+        # pages (paged layout only; see paddle_tpu.fleet.prefix_cache)
+        self.prefix_cache = None
+        if self.cfg.paged and self.cfg.prefix_cache_pages > 0:
+            from ..fleet.prefix_cache import PrefixCache
+
+            self.prefix_cache = PrefixCache(self.cfg.prefix_cache_pages,
+                                            self.cfg.page_size)
         self._captured_logits: Dict[int, List[np.ndarray]] = {}
         self._consecutive_failures = 0
         self._faults_absorbed = 0
@@ -259,6 +278,10 @@ class ServingEngine:
         self._closed = False
         self._draining = False
         self.last_drain: Optional[dict] = None
+        # drain re-entrancy latch: a nested drain (signal handler firing
+        # mid-drain, monitor thread) must observe, not re-enter
+        self._drain_active = False
+        self._drain_summary: Optional[dict] = None
         # continuous telemetry: refcounted process exporter (None when
         # PADDLE_TPU_TELEMETRY_DIR is unset — that check is one env read)
         self._telemetry = _telemetry.acquire()
@@ -419,38 +442,55 @@ class ServingEngine:
         bounded by ``timeout_s``; stragglers past it retire TIMEOUT with
         their pages reclaimed), then :meth:`close`. Returns and stores
         (``engine.last_drain``) a summary dict; ticks ``serving/drains``
-        and ``serving/drained_requests``. Idempotent: a second drain on a
-        drained engine returns the recorded summary untouched."""
-        if self._closed and self.last_drain is not None:
+        and ``serving/drained_requests``.
+
+        Idempotent AND re-entrant: a second drain on a drained engine
+        returns the recorded summary untouched, and a nested call (a
+        SIGTERM handler or monitor thread firing while a drain is already
+        running its decode loop) returns a snapshot of the in-progress
+        summary instead of re-entering the loop — the fleet router's
+        respawn paths call drain from exactly those contexts."""
+        if self.last_drain is not None:
             return self.last_drain
-        if timeout_s is None:
-            timeout_s = self.cfg.drain_timeout_s
+        if self._drain_active:
+            return dict(self._drain_summary or {})
+        self._drain_active = True
         summary = {"finished": 0, "timed_out": 0, "failed": 0,
                    "rejected": 0}
-        self._draining = True
-        _sm.DRAINS.inc()
-        now = time.perf_counter()
-        for req in self.scheduler.drain_queue():
-            req.finished_t = now
-            _trace.on_terminal(req, REJECTED, None)
-            summary["rejected"] += 1
-        deadline = time.monotonic() + timeout_s
-        while self.scheduler.occupancy and time.monotonic() < deadline:
-            for req in self.step():
-                key = {FINISHED: "finished", TIMEOUT: "timed_out",
-                       FAILED: "failed"}.get(req.state)
-                if key is not None:
-                    summary[key] += 1
-        for slot in range(self.cfg.slots):
-            if self.scheduler.slot_request(slot) is not None:
-                # past the drain budget: cut the straggler loose — TIMEOUT
-                # is its terminal state, pages return to the pool
-                self._retire(slot, state=TIMEOUT)
-                summary["timed_out"] += 1
-        _sm.DRAINED_REQUESTS.inc(summary["finished"])
-        self.last_drain = summary
-        self.close()
-        return summary
+        self._drain_summary = summary
+        try:
+            if timeout_s is None:
+                timeout_s = self.cfg.drain_timeout_s
+            self._draining = True
+            _sm.DRAINS.inc()
+            now = time.perf_counter()
+            for req in self.scheduler.drain_queue():
+                req.finished_t = now
+                _trace.on_terminal(req, REJECTED, None)
+                summary["rejected"] += 1
+            deadline = time.monotonic() + timeout_s
+            while self.scheduler.occupancy and time.monotonic() < deadline:
+                for req in self.step():
+                    key = {FINISHED: "finished", TIMEOUT: "timed_out",
+                           FAILED: "failed"}.get(req.state)
+                    if key is not None:
+                        summary[key] += 1
+            for slot in range(self.cfg.slots):
+                if self.scheduler.slot_request(slot) is not None:
+                    # past the drain budget: cut the straggler loose —
+                    # TIMEOUT is its terminal state, pages go to the pool
+                    self._retire(slot, state=TIMEOUT)
+                    summary["timed_out"] += 1
+            if self.prefix_cache is not None and self.pool is not None:
+                # cached prefix pages are engine-lifetime pins: a drained
+                # engine returns them so accounting ends at zero used
+                self.pool.free(self.prefix_cache.flush())
+            _sm.DRAINED_REQUESTS.inc(summary["finished"])
+            self.last_drain = summary
+            self.close()
+            return summary
+        finally:
+            self._drain_active = False
 
     def captured_logits(self, req: Request) -> List[np.ndarray]:
         """Per-emitted-token logits rows (``collect_logits=True`` only)."""
@@ -504,6 +544,8 @@ class ServingEngine:
         if self.pool is not None:
             out["pages_in_use"] = self.pool.num_used
             out["page_pool_utilization"] = round(self.pool.utilization, 4)
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = self.prefix_cache.stats()
         return out
 
     def health(self) -> dict:
@@ -540,6 +582,8 @@ class ServingEngine:
         if self.pool is None:
             return True
         held = sum(len(r.pages) for r in self.scheduler.running())
+        if self.prefix_cache is not None:
+            held += self.prefix_cache.pages_held
         return self.pool.num_used == held
 
     # -- admission + prefill --------------------------------------------------
@@ -596,8 +640,15 @@ class ServingEngine:
     def _prefill(self, req: Request, slot: int, bucket: int
                  ) -> Optional[Request]:
         """Run the per-bucket compiled prefill; returns the request if it
-        finished immediately (EOS first token / max_new_tokens == 1)."""
+        finished immediately (EOS first token / max_new_tokens == 1). With
+        a prefix cache armed, a prompt whose page-aligned prefix is cached
+        skips the full prefill: its pages are row-copied and only the
+        remainder runs (the resume executable)."""
         cfg = self.cfg
+        if self.prefix_cache is not None:
+            entry = self.prefix_cache.lookup(req.prompt)
+            if entry is not None:
+                return self._prefill_from_prefix(req, slot, entry)
         prompt = np.full((bucket,), cfg.pad_id, np.int32)
         prompt[:req.prompt_len] = req.prompt
         if cfg.paged:
@@ -619,6 +670,57 @@ class ServingEngine:
         _trace.on_prefill(req, slot, bucket, t0, t1)
         _sm.PREFILL_MS.observe((t1 - t0) * 1e3)
         _sm.PREFILL_COUNT.inc()
+        return self._finish_prefill(req, slot, tok, last_logits)
+
+    def _prefill_from_prefix(self, req: Request, slot: int, entry
+                             ) -> Optional[Request]:
+        """Serve admission from a prefix-cache hit: point this slot's page
+        table at the request's pages, row-copy the cached prefix KV into
+        them, then run ONLY the prompt remainder through the resume
+        executable (teacher-forced decode over the model's own serving
+        contract — model-agnostic, no second prefill trace). The first
+        sampled token is keyed (seed, prompt_len-1), identical to the cold
+        prefill path, so hit and miss generate the same stream."""
+        ps = self.cfg.page_size
+        n = entry.n_tokens
+        npages = len(entry.pages)
+        dest_np = self.cache_ops.prompt_dest(req.pages)
+        self._cache["pt"] = self._cache["pt"].at[slot].set(
+            jnp.asarray(dest_np))
+        rows = np.arange(ps, dtype=np.int32)
+        src = np.concatenate([p * ps + rows for p in entry.pages])
+        dst = np.concatenate([p * ps + rows for p in req.pages[:npages]])
+        t0 = time.perf_counter()
+        self._cache["k"] = self._cache["k"].at[:, dst].set(
+            self._cache["k"][:, src])
+        self._cache["v"] = self._cache["v"].at[:, dst].set(
+            self._cache["v"][:, src])
+        # (int8 layout: per-page scales are fixed constants — rows copy 1:1)
+        rbucket = self._bucket_for(req.prompt_len - n)
+        remainder = np.full((rbucket,), self.cfg.pad_id, np.int32)
+        remainder[:req.prompt_len - n] = req.prompt[n:]
+        exe = self._get_resume_exe(rbucket)
+        self._cache, first_tok, last_logits = exe(
+            self.params, self._cache, jnp.asarray(remainder),
+            jnp.asarray(n, jnp.int32),
+            jnp.asarray(req.prompt_len, jnp.int32),
+            jnp.asarray(slot, jnp.int32),
+            jnp.asarray(req.temperature, jnp.float32),
+            jnp.asarray(req.top_k, jnp.int32),
+            jnp.asarray(req.seed, jnp.int32))
+        tok = int(np.asarray(first_tok))
+        t1 = time.perf_counter()
+        _trace.on_prefill(req, slot, rbucket, t0, t1)
+        _sm.PREFILL_MS.observe((t1 - t0) * 1e3)
+        # deliberately NOT PREFILL_COUNT: the bench's "reduced prefill
+        # dispatches vs cold" assertion reads that counter
+        return self._finish_prefill(req, slot, tok, last_logits)
+
+    def _finish_prefill(self, req: Request, slot: int, tok: int,
+                        last_logits) -> Optional[Request]:
+        """Post-prefill bookkeeping shared by the cold and prefix-hit
+        paths: TTFT, first token, immediate retirement, slot arming."""
+        cfg = self.cfg
         _sm.TOKENS_GENERATED.inc()
         now = time.perf_counter()
         req.first_token_t = now
@@ -748,7 +850,11 @@ class ServingEngine:
         (``_fail_inflight_batch``) — no point in per-slot updates first."""
         req = self.scheduler.retire(slot, state)
         if self.pool is not None and req.pages:
-            self.pool.free(req.pages)
+            donated = 0
+            if self.prefix_cache is not None:
+                donated = self._donate_prefix_pages(req, state)
+            if donated < len(req.pages):
+                self.pool.free(req.pages[donated:])
             req.pages = []
         req.finished_t = time.perf_counter()
         _trace.on_terminal(req, state, slot)
@@ -765,6 +871,32 @@ class ServingEngine:
             # the next dispatch decodes a ghost
             self._active = self._active.at[slot].set(False)
         return req
+
+    def _donate_prefix_pages(self, req: Request, state: str) -> int:
+        """Zero-copy prefix-cache insert at retirement: a FINISHED
+        request's leading full-prompt pages transfer ownership to the
+        cache instead of returning to the pool. Returns how many of
+        ``req.pages`` the cache now owns (a prefix of the list — the
+        caller frees the rest). A request that did NOT finish never
+        donates: its pages may hold garbage from the failed dispatch, and
+        poisoned prefixes must be structurally unservable."""
+        cache = self.prefix_cache
+        n = cache.cacheable_len(req.prompt_len)
+        if n <= 0:
+            return 0
+        if state != FINISHED:
+            from ..fleet import metrics as _fm
+
+            _fm.PREFIX_POISONED_SKIPPED.inc()
+            return 0
+        tokens = req.prompt[:n]
+        if cache.contains(tokens):
+            return 0
+        npages = n // self.cfg.page_size
+        accepted, evicted = cache.insert(tokens, req.pages[:npages])
+        if evicted:
+            self.pool.free(evicted)
+        return npages if accepted else 0
 
     def _expire_deadlines(self) -> List[Request]:
         """Retire requests past their deadline — queued ones leave the
@@ -811,6 +943,10 @@ class ServingEngine:
         self._seed = jnp.zeros((b,), jnp.int32)
         if self._cache_lost():
             self._cache = self.cache_ops.init_state()
+            if self.prefix_cache is not None and self.pool is not None:
+                # the rows backing every cached prefix died with the
+                # donated buffers — the entries are lies now; drop them
+                self.pool.free(self.prefix_cache.flush())
         return failed
 
     def _batch_spec(self) -> dict:
@@ -908,6 +1044,66 @@ class ServingEngine:
              self._gen, self._maxnew, self._temp, self._topk, self._seed),
             donate_argnums=(1,))
         self._decode_exe[fuse] = exe
+        return exe
+
+    def _get_resume_exe(self, rbucket: int):
+        """Teacher-forced prompt-remainder ingest for a prefix-cache hit:
+        consume the uncached prompt tail token by token through the
+        model's own decode contract (each step writes KV at its absolute
+        position), then sample the first generated token from the final
+        step's logits, keyed (seed, prompt_len-1) — exactly the cold
+        prefill's keying, so the sampled stream is path-independent.
+        Compiled once per remainder bucket, cache donated like every other
+        step function."""
+        exe = self._resume_exe.get(rbucket)
+        if exe is not None:
+            return exe
+        model, ops, cfg = self.model, self.cache_ops, self.cfg
+        b = cfg.slots
+        vocab = self.model.cfg.vocab_size
+
+        def resume(params, cache, toks, start, length, slot, temp, topk,
+                   seed):
+            slotmask = jnp.arange(b, dtype=jnp.int32) == slot
+            tempv = jnp.where(slotmask, temp, 0.0).astype(jnp.float32)
+            topkv = jnp.where(slotmask, topk, 0).astype(jnp.int32)
+            seedv = jnp.where(slotmask, seed, 0).astype(jnp.int32)
+
+            def body(carry, i):
+                cache, tok_acc, log_acc = carry
+                pos = start + i
+                ac = slotmask & (pos < length)
+                tkb = jnp.where(slotmask, toks[i], 0).astype(jnp.int32)
+                posb = jnp.full((b,), pos, jnp.int32)
+                logits, cache = model.decode(params, cache, ops, tkb,
+                                             posb, ac)
+                is_last = ac & (pos == length - 1)
+                cand = _sample_tokens(logits, tempv, topkv, seedv, posb)
+                tok_acc = tok_acc + jnp.sum(
+                    jnp.where(is_last, cand, 0).astype(jnp.int32))
+                log_acc = log_acc + jnp.sum(
+                    jnp.where(is_last[:, None],
+                              logits.astype(jnp.float32), 0.0), axis=0)
+                return (cache, tok_acc, log_acc), None
+
+            init = (cache, jnp.zeros((), jnp.int32),
+                    jnp.zeros((vocab,), jnp.float32))
+            (cache, tok, last), _ = jax.lax.scan(
+                body, init, jnp.arange(rbucket, dtype=jnp.int32))
+            return cache, tok, last
+
+        exe = aot_compile(
+            resume,
+            (self.params, self._cache,
+             jax.ShapeDtypeStruct((rbucket,), jnp.int32),
+             jax.ShapeDtypeStruct((), jnp.int32),
+             jax.ShapeDtypeStruct((), jnp.int32),
+             jax.ShapeDtypeStruct((), jnp.int32),
+             jax.ShapeDtypeStruct((), jnp.float32),
+             jax.ShapeDtypeStruct((), jnp.int32),
+             jax.ShapeDtypeStruct((), jnp.int32)),
+            donate_argnums=(1,))
+        self._resume_exe[rbucket] = exe
         return exe
 
     def warmup(self, buckets: Optional[Sequence[int]] = None) -> None:

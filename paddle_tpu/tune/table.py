@@ -50,7 +50,7 @@ __all__ = [
     "bucket_ctx",
     "table_path", "shipped_path", "entry_key",
     "lookup", "record", "read_entries", "write_entries",
-    "resolve_decode_fuse",
+    "resolve_decode_fuse", "resolve_fleet_router",
     "provenance_snapshot", "reset_provenance",
 ]
 
@@ -346,6 +346,31 @@ def resolve_decode_fuse(slots: int) -> Tuple[int, str]:
     except Exception:
         pass
     return 1, "default"
+
+
+def resolve_fleet_router(cpus: Optional[int] = None
+                         ) -> Tuple[Dict[str, object], str]:
+    """(router config, source) for the fleet router — THE shared
+    resolution ``fleet.FleetConfig(replicas="auto")`` and
+    ``tools/fleet_bench`` both use. The config dict carries ``replicas``
+    (int) and ``affinity`` (``"prefix"``/``"round_robin"``), bucketed by
+    host CPU count (replica workers are processes — the useful count
+    tracks cores, not devices). ``({"replicas": 2, "affinity": "prefix"},
+    "default")`` on no entry or any table failure: the fleet must come up
+    with no table on disk."""
+    default = {"replicas": 2, "affinity": "prefix"}
+    try:
+        if cpus is None:
+            cpus = os.cpu_count() or 1
+        cfg, src = lookup("fleet.router", bucket_slots(int(cpus)))
+        if cfg and int(cfg.get("replicas", 0)) > 0:
+            out = {"replicas": int(cfg["replicas"]),
+                   "affinity": cfg.get("affinity", "prefix")}
+            if out["affinity"] in ("prefix", "round_robin"):
+                return out, src
+    except Exception:
+        pass
+    return default, "default"
 
 
 def provenance_snapshot() -> Dict[str, dict]:

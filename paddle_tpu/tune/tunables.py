@@ -577,3 +577,64 @@ class DecodeFuseTunable(Tunable):
                 pass
         self._open.clear()
         self._models.clear()
+
+
+@register_tunable("fleet.router")
+class FleetRouterTunable(Tunable):
+    """Replica count + affinity policy for the fleet router. Measured as
+    end-to-end drain time of a fixed request stream through an in-process
+    sim fleet (device-latency model): more replicas overlap more modeled
+    device wait but add routing/protocol overhead, and prefix affinity
+    trades spread for locality — host- and stream-dependent, so measured.
+    Bucketed by host CPU count (replica workers are processes)."""
+
+    kernel = "fleet.router"
+
+    def __init__(self):
+        self._open: list = []
+
+    def default_shapes(self):
+        import os as _os
+
+        return [dict(cpus=_os.cpu_count() or 1, slots=4, step_ms=2.0,
+                     n_requests=32, max_new=8)]
+
+    def bucket(self, shape):
+        return _table.bucket_slots(shape["cpus"])
+
+    def candidates(self, shape):
+        return [{"replicas": n, "affinity": a}
+                for n in (1, 2, 4)
+                for a in ("prefix", "round_robin")]
+
+    def default_config(self, shape):
+        return {"replicas": 2, "affinity": "prefix"}
+
+    def build(self, shape, config):
+        from ..fleet import FleetConfig, Router, SimConfig, SimEngine
+
+        router = Router(FleetConfig(
+            replicas=int(config["replicas"]),
+            mode="inprocess", affinity=config["affinity"],
+            engine_factory=lambda i: SimEngine(SimConfig(
+                slots=shape["slots"], step_ms=shape["step_ms"]))))
+        self._open.append(router)
+        n_requests = int(shape["n_requests"])
+        max_new = int(shape["max_new"])
+
+        def drive():
+            frs = [router.submit([1, 2, 3, i % 7], max_new)
+                   for i in range(n_requests)]
+            ok = router.wait_all(60.0)
+            assert ok and all(f.state == "finished" for f in frs)
+            return len(frs)
+
+        return drive, ()
+
+    def cleanup(self):
+        for router in self._open:
+            try:
+                router.close()
+            except Exception:
+                pass
+        self._open.clear()

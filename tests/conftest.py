@@ -26,6 +26,12 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    # tier-1 CI runs `-m 'not slow'`; slow marks the opt-out extras
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 `-m 'not slow'` run")
+
+
 @pytest.fixture(autouse=True)
 def _fresh_programs():
     """Each test gets fresh default programs + scope + name generator."""

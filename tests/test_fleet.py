@@ -1,0 +1,391 @@
+"""Fleet subsystem tests: prefix-cache hashing/LRU/poisoning, the frame
+protocol, router exactly-once accounting under kill/restart, and
+cross-process telemetry aggregation (ISSUE 15 tentpole coverage). Router
+tests run on in-process sim engines — the process-worker path is covered
+by tools/fleet_bench and tools/chaos_drill (smoke gates)."""
+
+import io
+import os
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.fleet import (FleetBackpressure, FleetConfig, FleetRequest,
+                              PrefixCache, Router, SimConfig, SimEngine,
+                              aggregate_telemetry, prefix_key)
+from paddle_tpu.fleet import metrics as fm
+from paddle_tpu.fleet.protocol import MAX_FRAME, FrameReader, read_frame, \
+    send_frame
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- prefix_key ---------------------------------------------------------------
+class TestPrefixKey:
+    def test_deterministic_and_order_sensitive(self):
+        assert prefix_key([1, 2, 3]) == prefix_key([1, 2, 3])
+        assert prefix_key([1, 2, 3]) != prefix_key([3, 2, 1])
+        assert prefix_key([1, 2]) != prefix_key([1, 2, 3])
+        # numpy ints and Python ints hash identically
+        import numpy as np
+
+        assert prefix_key(np.array([5, 6, 7])) == prefix_key([5, 6, 7])
+
+    def test_stable_across_processes(self):
+        """The router and its worker replicas MUST derive the same key
+        from the same tokens — Python hash() is salted per process, so
+        this would fail if prefix_key ever leaned on it."""
+        toks = list(range(40, 72))
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "from paddle_tpu.fleet.prefix_cache import prefix_key;"
+             "print(prefix_key(range(40, 72)))"],
+            cwd=_REPO, env=dict(os.environ, JAX_PLATFORMS="cpu",
+                                PYTHONHASHSEED="12345"),
+            capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip() == prefix_key(toks)
+
+
+# -- PrefixCache (host bookkeeping) -------------------------------------------
+class TestPrefixCache:
+    def test_cacheable_len_keeps_a_remainder_token(self):
+        c = PrefixCache(page_budget=8, page_size=8)
+        # a prompt that exactly fills pages still leaves >= 1 token out
+        assert c.cacheable_len(16) == 8
+        assert c.cacheable_len(17) == 16
+        assert c.cacheable_len(8) == 0
+        assert c.cacheable_len(3) == 0
+
+    def test_insert_lookup_longest_match(self):
+        c = PrefixCache(page_budget=8, page_size=4)
+        base = list(range(100, 112))  # 12 tokens = 3 pages
+        ok, evicted = c.insert(base[:4], [0])
+        assert ok and not evicted
+        ok, _ = c.insert(base[:8], [1, 2])
+        assert ok
+        # longest page-aligned prefix wins: 12-token prompt -> 8-token hit
+        hit = c.lookup(base + [999])
+        assert hit is not None and hit.tokens == tuple(base[:8])
+        assert hit.pages == [1, 2]
+        # shorter prompt falls back to the 4-token entry
+        hit = c.lookup(base[:6])
+        assert hit is not None and hit.tokens == tuple(base[:4])
+        # different tokens with the same length miss entirely
+        assert c.lookup([7] * 12) is None
+
+    def test_refusals_keep_ownership_with_caller(self):
+        c = PrefixCache(page_budget=2, page_size=4)
+        assert c.insert([1, 2, 3, 4], [10]) == (True, [])
+        # duplicate: refused, nothing evicted
+        assert c.insert([1, 2, 3, 4], [11]) == (False, [])
+        # token/page length mismatch: refused
+        assert c.insert([1, 2, 3], [12]) == (False, [])
+        # larger than the whole budget: refused even against an empty LRU
+        assert c.insert(list(range(12)), [13, 14, 15]) == (False, [])
+        assert c.pages_held == 1
+
+    def test_lru_eviction_returns_pages(self):
+        c = PrefixCache(page_budget=2, page_size=4)
+        c.insert([1, 2, 3, 4], [10])
+        c.insert([5, 6, 7, 8], [11])
+        # touch the first entry so the SECOND is LRU
+        assert c.lookup([1, 2, 3, 4, 9]) is not None
+        ok, evicted = c.insert([9, 10, 11, 12], [12])
+        assert ok and evicted == [11], "LRU order ignored recency"
+        assert c.pages_held == 2 and len(c) == 2
+
+    def test_flush_returns_every_owned_page(self):
+        c = PrefixCache(page_budget=4, page_size=4)
+        c.insert([1, 2, 3, 4], [10])
+        c.insert([5, 6, 7, 8], [11, 12][:1])
+        assert sorted(c.flush()) == [10, 11]
+        assert c.pages_held == 0 and len(c) == 0 and c.flush() == []
+
+    def test_counters_tick(self):
+        h0, m0 = fm.PREFIX_HITS.value, fm.PREFIX_MISSES.value
+        i0, e0 = fm.PREFIX_INSERTS.value, fm.PREFIX_EVICTIONS.value
+        c = PrefixCache(page_budget=1, page_size=4)
+        c.insert([1, 2, 3, 4], [0])
+        assert c.lookup([1, 2, 3, 4, 5]) is not None
+        assert c.lookup([9, 9, 9, 9, 9]) is None
+        c.insert([5, 6, 7, 8], [1])  # evicts the first
+        assert fm.PREFIX_HITS.value == h0 + 1
+        assert fm.PREFIX_MISSES.value == m0 + 1
+        assert fm.PREFIX_INSERTS.value == i0 + 2
+        assert fm.PREFIX_EVICTIONS.value == e0 + 1
+
+
+# -- frame protocol -----------------------------------------------------------
+class TestProtocol:
+    def test_round_trip(self):
+        buf = io.BytesIO()
+        docs = [{"op": "submit", "id": 3, "prompt": [1, 2, 3]},
+                {"ev": "result", "tokens": list(range(100)),
+                 "error": None, "unicode": "påge"}]
+        for d in docs:
+            send_frame(buf, d)
+        buf.seek(0)
+        assert [read_frame(buf) for _ in docs] == docs
+        assert read_frame(buf) is None  # clean EOF
+
+    def test_torn_frame_is_eof_not_garbage(self):
+        buf = io.BytesIO()
+        send_frame(buf, {"a": 1})
+        data = buf.getvalue()
+        for cut in (1, 3, 5, len(data) - 1):  # mid-header and mid-payload
+            assert read_frame(io.BytesIO(data[:cut])) is None
+
+    def test_oversized_frame_rejected(self):
+        buf = io.BytesIO((MAX_FRAME + 1).to_bytes(4, "big") + b"x")
+        with pytest.raises(ValueError):
+            read_frame(buf)
+
+    def test_reader_reassembles_split_writes(self):
+        r, w = os.pipe()
+        try:
+            os.set_blocking(r, False)
+            reader = FrameReader(r)
+            buf = io.BytesIO()
+            send_frame(buf, {"n": 1})
+            send_frame(buf, {"n": 2})
+            data = buf.getvalue()
+            got = []
+            for i in range(0, len(data), 3):  # drip 3 bytes at a time
+                os.write(w, data[i:i + 3])
+                got.extend(reader.drain())
+            assert got == [{"n": 1}, {"n": 2}]
+            os.close(w)
+            assert reader.drain() == [] and reader.eof
+        finally:
+            os.close(r)
+
+
+# -- router over in-process sims ----------------------------------------------
+def _sim_router(n=2, slots=2, **kw):
+    kw.setdefault("affinity", "round_robin")
+    return Router(FleetConfig(
+        replicas=n, mode="inprocess",
+        engine_factory=lambda i: SimEngine(SimConfig(slots=slots)), **kw))
+
+
+class TestRouter:
+    def test_exactly_once_and_seed_pinning(self):
+        router = _sim_router()
+        frs = [router.submit([1, i], 4) for i in range(8)]
+        assert all(f.seed is not None for f in frs), \
+            "unseeded requests cannot replay deterministically"
+        assert router.wait_all(20.0)
+        acc = router.accounting()
+        assert len(acc) == 8 and set(acc.values()) == {"finished"}
+        assert all(len(f.tokens) == 4 for f in frs)
+        router.close()
+
+    def test_backpressure_is_typed_not_silent(self):
+        router = _sim_router(n=1, max_queue=2, max_outstanding=1)
+        router.submit([1], 4)
+        router.submit([2], 4)
+        with pytest.raises(FleetBackpressure):
+            router.submit([3], 4)
+        assert router.wait_all(20.0)
+        router.close()
+        with pytest.raises(FleetBackpressure):
+            router.submit([4], 4)  # closed router rejects loudly too
+
+    def test_kill_requeues_and_replays_bit_identical(self):
+        req0 = fm.REQUEUED.value
+        router = _sim_router(n=2, slots=1)
+        frs = [router.submit([3, 3, i], 6, temperature=0.9)
+               for i in range(6)]
+        for _ in range(2):
+            router.pump()
+        router._replicas[1].kill()
+        assert router.wait_all(20.0)
+        assert set(router.accounting().values()) == {"finished"}
+        assert fm.REQUEUED.value > req0
+        twin = _sim_router(n=1, slots=1)
+        frs_t = [twin.submit([3, 3, i], 6, temperature=0.9)
+                 for i in range(6)]
+        assert twin.wait_all(20.0)
+        assert [f.tokens for f in frs] == [f.tokens for f in frs_t]
+        router.close()
+        twin.close()
+
+    def test_requeue_limit_fails_loudly(self):
+        """A request that keeps landing on dying replicas must become
+        FAILED — never retry forever, never vanish."""
+        router = _sim_router(n=1, slots=1, requeue_limit=1,
+                             auto_restart=False)
+        fr = router.submit([1, 2], 4)
+        router.pump()
+        router._replicas[0].kill()
+        # manual respawn/kill cycle: each pump requeues, each kill burns
+        # one attempt
+        for _ in range(4):
+            router.pump()
+            if fr.terminal:
+                break
+            router._respawn(0)
+            router.pump()
+            router._replicas[0].kill()
+        assert fr.state == "failed", fr.state
+        assert router.accounting()[fr.id] == "failed"
+        router.close()
+
+    def test_rolling_restart_rejects_nothing(self):
+        router = _sim_router(n=2)
+        frs = [router.submit([2, i], 5) for i in range(6)]
+        for _ in range(2):
+            router.pump()
+        router.rolling_restart(10.0)
+        assert router.wait_all(20.0)
+        acc = router.accounting()
+        assert "rejected" not in acc.values(), acc
+        assert all(f.state == "finished" for f in frs)
+        router.close()
+
+    def test_degraded_replica_gets_no_new_traffic(self):
+        engines = {}
+
+        def factory(i):
+            engines[i] = SimEngine(SimConfig(slots=2))
+            return engines[i]
+
+        router = Router(FleetConfig(replicas=2, mode="inprocess",
+                                    affinity="round_robin",
+                                    engine_factory=factory))
+        engines[0].force_degraded = True
+        frs = [router.submit([4, i], 3) for i in range(6)]
+        assert router.wait_all(20.0)
+        assert all(f.state == "finished" for f in frs)
+        assert all(f.last_replica == 1 for f in frs), \
+            [f.last_replica for f in frs]
+        router.close()
+
+    def test_drain_terminates_everything_exactly_once(self):
+        router = _sim_router(n=2)
+        frs = [router.submit([6, i], 4) for i in range(5)]
+        router.drain()
+        states = {f.state for f in frs}
+        assert states <= {"finished", "rejected"}, states
+        acc = router.accounting()
+        assert len(acc) == 5 and all(v in ("finished", "rejected")
+                                     for v in acc.values())
+
+    def test_fleet_request_doc_round_trips_the_wire_fields(self):
+        fr = FleetRequest(7, [1, 2, 3], 5, temperature=0.5, top_k=3,
+                          seed=42)
+        d = fr.doc()
+        assert d["id"] == 7 and d["prompt"] == [1, 2, 3]
+        assert d["max_new_tokens"] == 5 and d["seed"] == 42
+        import json
+
+        assert json.loads(json.dumps(d)) == d  # frame-protocol safe
+
+
+# -- telemetry aggregation ----------------------------------------------------
+class TestAggregateTelemetry:
+    def test_merges_replica_rings(self, tmp_path):
+        from paddle_tpu.monitor import metrics as mx
+        from paddle_tpu.monitor import telemetry
+
+        mx.enable()
+        base = str(tmp_path / "fleet")
+        for i in range(3):
+            d = os.path.join(base, "replica_%d" % i)
+            os.makedirs(d)
+            exp = telemetry.TelemetryExporter(d, interval_s=999.0)
+            mx.counter("test/fleet_agg").inc(i + 1)
+            exp.tick()
+            exp.stop()
+        agg = aggregate_telemetry(base)
+        assert sorted(agg) == ["replica_0", "replica_1", "replica_2"]
+        for v in agg.values():
+            assert v["samples"] >= 1 and "last" in v
+
+    def test_empty_base_is_empty_not_fatal(self, tmp_path):
+        assert aggregate_telemetry(str(tmp_path)) == {}
+        assert aggregate_telemetry(str(tmp_path / "nonexistent")) == {}
+
+
+# -- engine-level prefix cache (real model) -----------------------------------
+@pytest.fixture(scope="module")
+def tiny_model():
+    from paddle_tpu.models import decoder_lm
+
+    cfg = decoder_lm.DecoderConfig(vocab_size=64, n_layer=1, d_model=16,
+                                   n_head=2, max_seq=64)
+    return decoder_lm.DecoderLM(cfg, seed=3)
+
+
+def _prefix_engine(model, pages=8):
+    from paddle_tpu import serving
+
+    return serving.ServingEngine(model, serving.ServingConfig(
+        slots=2, page_size=8, max_seq=64, num_pages=32,
+        prefix_cache_pages=pages))
+
+
+class TestEnginePrefixCache:
+    def test_config_validates_budget(self, tiny_model):
+        from paddle_tpu import serving
+
+        with pytest.raises(ValueError):
+            serving.ServingConfig(slots=2, page_size=8, max_seq=64,
+                                  num_pages=16, prefix_cache_pages=16)
+
+    def test_hit_skips_prefill_and_matches_cold_stream(self, tiny_model):
+        from paddle_tpu.serving import metrics as sm
+
+        sys_prompt = list(range(1, 18))  # 17 tokens: 2 full pages cached
+        eng = _prefix_engine(tiny_model)
+        p0 = sm.PREFILL_COUNT.value
+        h0 = fm.PREFIX_HITS.value
+        r1 = eng.submit(sys_prompt + [30], 5, temperature=0.8, seed=11)
+        eng.run()
+        r2 = eng.submit(sys_prompt + [30], 5, temperature=0.8, seed=11)
+        eng.run()
+        assert r1.state == r2.state == "finished"
+        assert list(r2.tokens_out) == list(r1.tokens_out), \
+            "a prefix hit changed the sampled stream"
+        assert fm.PREFIX_HITS.value == h0 + 1
+        assert sm.PREFILL_COUNT.value == p0 + 1, \
+            "the warm request still dispatched a full prefill"
+        assert eng.page_accounting_ok()
+        eng.drain(10.0)
+        assert eng.pool.num_used == 0, "prefix pages leaked through drain"
+
+    def test_failed_request_never_donates(self, tiny_model):
+        from paddle_tpu.reliability import FaultPlan, faults
+
+        eng = _prefix_engine(tiny_model)
+        pk0 = fm.PREFIX_POISONED_SKIPPED.value
+        plan = FaultPlan([faults.FaultSpec("serving.decode", "fatal",
+                                           at=1, times=1)])
+        with plan:
+            bad = eng.submit(list(range(1, 18)), 5)
+            eng.run(max_steps=50)
+        assert bad.state == "failed"
+        assert fm.PREFIX_POISONED_SKIPPED.value > pk0
+        assert len(eng.prefix_cache) == 0, \
+            "a FAILED request's pages entered the prefix cache"
+        assert eng.page_accounting_ok() and eng.pool.num_used == 0
+        # the poisoned prefix is structurally unservable: a fresh request
+        # with the same prompt misses and re-prefills cleanly
+        h0 = fm.PREFIX_HITS.value
+        good = eng.submit(list(range(1, 18)), 3, seed=5)
+        eng.run()
+        assert good.state == "finished" and fm.PREFIX_HITS.value == h0
+        eng.drain(10.0)
+
+    def test_accounting_includes_cache_owned_pages(self, tiny_model):
+        eng = _prefix_engine(tiny_model)
+        r = eng.submit(list(range(1, 18)), 3, seed=9)
+        eng.run()
+        assert r.state == "finished"
+        assert eng.prefix_cache.pages_held == 2
+        assert eng.pool.num_used == 2, "donated pages were double-freed"
+        assert eng.page_accounting_ok()
+        eng.drain(10.0)
+        assert eng.pool.num_used == 0

@@ -1,7 +1,8 @@
 """Chaos drills: injected-fault recovery invariants as a CI smoke gate.
 
     python -m tools.chaos_drill --selftest
-        <5s, JAX_PLATFORMS=cpu. Runs the drills in-process and asserts the
+        JAX_PLATFORMS=cpu; drills 1-4 run in-process in a few seconds,
+        the fleet drill adds real worker-process spawns. Asserts the
         recovery invariants (the ROADMAP smoke-gate entry):
 
         1. TRAINING — an injected preemption signal mid-run makes
@@ -32,6 +33,14 @@
            logic): the per-step record-id ledger of the stitched run shows
            every record consumed exactly once, matching the uninterrupted
            twin's ledger.
+
+        5. FLEET — two real-engine worker PROCESSES behind the fleet
+           router; one is SIGKILLed mid-traffic. Every request reaches
+           exactly one terminal state (zero silent drops, zero duplicate
+           results), the requeued seeded requests replay BIT-IDENTICAL to
+           an unkilled in-process twin, and a rolling restart under
+           traffic terminates nothing as 'rejected'. (This leg dominates
+           the gate's wall time: it spawns and warms real workers.)
 
     python -m tools.chaos_drill --parse 'site@N=kind[:times[:ms]];...'
         Validate a PADDLE_TPU_FAULT_PLAN grammar string and print the
@@ -374,6 +383,80 @@ def drill_serving() -> None:
           "deadline retired TIMEOUT; zero page leaks)")
 
 
+def drill_fleet() -> None:
+    """ISSUE 15's fleet chaos drill, on REAL engines in REAL processes:
+    SIGKILL a replica mid-traffic -> exactly one terminal outcome per
+    request, zero silent drops, and the requeued seeded requests replay
+    bit-identical to an unkilled in-process twin; then a rolling restart
+    under traffic terminates nothing as 'rejected'."""
+    from paddle_tpu.fleet import FleetConfig, Router
+    from paddle_tpu.fleet import metrics as fm
+    from paddle_tpu.models.decoder_lm import DecoderConfig, DecoderLM
+    from paddle_tpu.serving.engine import ServingConfig, ServingEngine
+
+    # drill_serving's geometry: one layer, one prompt bucket, tiny
+    # compiles — workers warm up fast off the shared compile cache
+    mcfg = dict(vocab_size=64, n_layer=1, d_model=16, n_head=2, max_seq=32)
+    scfg = dict(slots=2, page_size=8, max_seq=32)
+    spec = {"engine": "real", "model": mcfg, "model_seed": 0,
+            "serving": scfg, "warmup": True}
+    jobs = [([1 + i, 2, 3, 4], 5) for i in range(10)]
+
+    router = Router(FleetConfig(replicas=2, mode="process",
+                                affinity="round_robin", engine_spec=spec,
+                                max_outstanding=2))
+    frs = [router.submit(p, m, temperature=0.6, seed=900 + i)
+           for i, (p, m) in enumerate(jobs)]
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline \
+            and not router._replicas[0].inflight:
+        router.pump()
+        time.sleep(0.005)
+    assert router._replicas[0].inflight, "no traffic reached the victim"
+    req0, r0 = fm.REQUEUED.value, fm.REPLICA_RESTARTS.value
+    dup0 = fm.DUPLICATE_RESULTS.value
+    router._replicas[0].kill()  # real SIGKILL, KV pages and all
+    assert router.wait_all(120.0), "fleet never drained after SIGKILL"
+    acc = router.accounting()
+    assert set(acc.values()) == {"finished"}, \
+        "SIGKILL produced drops/failures: %s" % acc
+    assert fm.REQUEUED.value > req0, "kill lost no in-flight work?"
+    assert fm.REPLICA_RESTARTS.value > r0, "dead worker not respawned"
+    assert fm.DUPLICATE_RESULTS.value == dup0, "double-terminal after kill"
+
+    # the unkilled twin: same model seed, same request seeds, one
+    # in-process engine — streams must match bit for bit
+    def factory(i):
+        model = DecoderLM(DecoderConfig(**mcfg), seed=0)
+        return ServingEngine(model, ServingConfig(**scfg))
+
+    twin = Router(FleetConfig(replicas=1, mode="inprocess",
+                              engine_factory=factory))
+    frs_t = [twin.submit(p, m, temperature=0.6, seed=900 + i)
+             for i, (p, m) in enumerate(jobs)]
+    assert twin.wait_all(60.0)
+    assert [f.tokens for f in frs] == [f.tokens for f in frs_t], \
+        "requeued replay diverged from the unkilled twin"
+    twin.close()
+
+    # rolling restart under fresh traffic: drain -> respawn each replica
+    # in turn; shed work is re-routed, never terminal 'rejected'
+    frs2 = [router.submit(p, m, temperature=0.6, seed=990 + i)
+            for i, (p, m) in enumerate(jobs[:6])]
+    rr0 = fm.ROLLING_RESTARTS.value
+    router.rolling_restart(60.0)
+    assert router.wait_all(120.0), "fleet never drained after restart"
+    assert fm.ROLLING_RESTARTS.value > rr0
+    acc = router.accounting()
+    assert "rejected" not in acc.values(), \
+        "rolling restart terminally rejected a request: %s" % acc
+    assert all(f.state == "finished" and f.tokens for f in frs2)
+    router.close()
+    print("chaos_drill: fleet drill OK (SIGKILL absorbed exactly-once, "
+          "replay bit-identical to unkilled twin, rolling restart "
+          "rejected nothing)")
+
+
 def selftest() -> int:
     t0 = time.perf_counter()
     with tempfile.TemporaryDirectory() as tmp:
@@ -391,6 +474,7 @@ def selftest() -> int:
         drill_exactly_once(tmp)
         drill_training(tmp)
         drill_serving()
+        drill_fleet()
     dt = time.perf_counter() - t0
     print("chaos_drill selftest: OK (%.1fs)" % dt)
     return 0

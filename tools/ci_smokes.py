@@ -28,6 +28,7 @@ GATES = (
     ("paged_attention", "paddle_tpu.ops.pallas_kernels.paged_attention"),
     ("profile_report", "tools.profile_report"),
     ("serve_bench", "tools.serve_bench"),
+    ("fleet_bench", "tools.fleet_bench"),
     ("chaos_drill", "tools.chaos_drill"),
     ("autotune", "tools.autotune"),
     ("check_budgets", "tools.check_budgets"),

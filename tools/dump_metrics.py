@@ -19,7 +19,11 @@ The tools/timeline.py of this stack, plus a metrics pretty-printer:
         code: ``from tools.dump_metrics import watch; watch(1.0)`` in a
         thread next to a running engine — a separate process sees its own
         registry, so there it tails a telemetry ring dir instead:
-        ``--watch <interval_s> <PADDLE_TPU_TELEMETRY_DIR>``.)
+        ``--watch <interval_s> <PADDLE_TPU_TELEMETRY_DIR>``.) Multiple
+        dirs — ``--watch 1 dir1 dir2`` or ``dir1,dir2`` — tail N rings
+        into one merged view (lines labeled by source dir); a fleet
+        ``telemetry_base`` holding ``replica_*/`` subdirs expands to all
+        of its replicas' rings.
 
     python -m tools.dump_metrics --selftest
         Exercise registry + tracer + the Chrome-trace round-trip +
@@ -103,42 +107,77 @@ def _delta_lines(sample) -> list:
     return lines
 
 
-def watch(interval_s: float, telemetry_dir: str = None,
+def _expand_watch_dirs(telemetry_dir) -> list:
+    """Normalize the --watch dir argument: a single dir, a comma-joined
+    list, or a Python list — plus one level of fleet expansion: a dir
+    containing ``replica_*/`` subdirs (the router's ``telemetry_base``)
+    tails every replica's ring, merged."""
+    if telemetry_dir is None:
+        return []
+    dirs = (list(telemetry_dir) if isinstance(telemetry_dir, (list, tuple))
+            else [d for d in str(telemetry_dir).split(",") if d])
+    out = []
+    for d in dirs:
+        subs = sorted(
+            os.path.join(d, name) for name in
+            (os.listdir(d) if os.path.isdir(d) else [])
+            if name.startswith("replica_")
+            and os.path.isdir(os.path.join(d, name)))
+        out.extend(subs if subs else [d])
+    return out
+
+
+def watch(interval_s: float, telemetry_dir=None,
           max_ticks: int = None) -> int:
     """Print interval deltas every ``interval_s``. With ``telemetry_dir``
-    set, tail another process's JSONL telemetry ring (the exporter's
-    output dir) instead of the local registry; otherwise run a private
-    in-process exporter with no disk ring. ``max_ticks`` bounds the loop
-    (tests); None = until KeyboardInterrupt. The ring tail re-parses the
-    whole (bounded: rotate × keep samples) ring each interval and filters
-    by per-writer seq — simple over fast, this is an ops tool."""
+    set, tail other processes' JSONL telemetry rings (exporter output
+    dirs) instead of the local registry; otherwise run a private
+    in-process exporter with no disk ring. ``telemetry_dir`` may be one
+    dir, a comma-joined list ("dir1,dir2"), a Python list, or a fleet
+    ``telemetry_base`` containing ``replica_*/`` subdirs — N rings tail
+    into one merged view, each line group labeled by its source dir.
+    ``max_ticks`` bounds the loop (tests); None = until KeyboardInterrupt.
+    The ring tail re-parses the whole (bounded: rotate × keep samples)
+    ring each interval and filters by per-(dir, writer) seq — simple over
+    fast, this is an ops tool."""
     import time
 
     from paddle_tpu.monitor import telemetry
     from paddle_tpu.monitor.telemetry import TelemetrySample
 
     ticks = 0
+    dirs = _expand_watch_dirs(telemetry_dir)
     try:
-        if telemetry_dir:
+        if dirs:
             # track the monotone per-writer seq, NOT the list index: a
             # ring rotation prunes old files, shrinking the list without
             # un-publishing samples (index tracking would go blind for a
-            # whole rotation's worth of samples after each prune)
+            # whole rotation's worth of samples after each prune). Keyed
+            # (dir, pid): two replicas' rings never shadow each other.
             last_seq = {}
+            label = len(dirs) > 1
             while max_ticks is None or ticks < max_ticks:
-                for doc in telemetry.read_series(telemetry_dir):
-                    pid = doc.get("pid", 0)
-                    if doc.get("seq", 0) <= last_seq.get(pid, -1):
+                for d in dirs:
+                    try:
+                        series = telemetry.read_series(d)
+                    except Exception:
                         continue
-                    last_seq[pid] = doc.get("seq", 0)
-                    sample = TelemetrySample(
-                        doc.get("seq", 0), doc.get("t", 0.0),
-                        doc.get("dt_s", 0.0), doc.get("metrics", {}),
-                        doc.get("deltas", {}))
-                    body = _delta_lines(sample)
-                    print("-- seq %d (dt %.2fs)" % (sample.seq, sample.dt_s))
-                    for line in body:
-                        print(line)
+                    for doc in series:
+                        key = (d, doc.get("pid", 0))
+                        if doc.get("seq", 0) <= last_seq.get(key, -1):
+                            continue
+                        last_seq[key] = doc.get("seq", 0)
+                        sample = TelemetrySample(
+                            doc.get("seq", 0), doc.get("t", 0.0),
+                            doc.get("dt_s", 0.0), doc.get("metrics", {}),
+                            doc.get("deltas", {}))
+                        body = _delta_lines(sample)
+                        src = (" [%s]" % os.path.basename(d.rstrip("/"))
+                               if label else "")
+                        print("-- seq %d (dt %.2fs)%s"
+                              % (sample.seq, sample.dt_s, src))
+                        for line in body:
+                            print(line)
                 ticks += 1
                 time.sleep(interval_s)
             return 0
@@ -518,6 +557,48 @@ def selftest() -> int:
                  "autotune/measure_ms"):
         assert name in snap, "missing instrument %s" % name
     metrics.reset()
+
+    # 9. fleet/* registry + multi-dir watch aggregation: importing the
+    #    fleet metrics module must register the full router + prefix-cache
+    #    instrument set, and --watch must merge N replica ring dirs with
+    #    per-(dir, pid) cursors (the fleet's N-replica tail view)
+    import contextlib
+    import io
+
+    import paddle_tpu.fleet.metrics  # noqa: F401  (registers fleet/*)
+
+    snap = metrics.snapshot()
+    for name in ("fleet/submitted", "fleet/routed", "fleet/requeued",
+                 "fleet/completed", "fleet/rejected",
+                 "fleet/duplicate_results", "fleet/queue_depth",
+                 "fleet/replicas_alive", "fleet/replica_restarts",
+                 "fleet/rolling_restarts", "fleet/no_healthy_replica",
+                 "fleet/rerouted",
+                 "fleet/prefix_cache/hits", "fleet/prefix_cache/misses",
+                 "fleet/prefix_cache/inserts",
+                 "fleet/prefix_cache/evictions",
+                 "fleet/prefix_cache/entries",
+                 "fleet/prefix_cache/pages_held",
+                 "fleet/prefix_cache/tokens_reused",
+                 "fleet/prefix_cache/poisoned_skipped"):
+        assert name in snap, "missing fleet instrument %s" % name
+    with tempfile.TemporaryDirectory() as td:
+        base = os.path.join(td, "fleet")
+        for i in range(2):
+            d = os.path.join(base, "replica_%d" % i)
+            os.makedirs(d)
+            exp = telemetry.TelemetryExporter(d, interval_s=999.0)
+            metrics.counter("selftest/fleet_tick").inc(i + 1)
+            exp.tick()
+            exp.stop()
+        assert _expand_watch_dirs(base) == [
+            os.path.join(base, "replica_0"), os.path.join(base, "replica_1")]
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            watch(0.0, base, max_ticks=1)
+        out = buf.getvalue()
+        assert "[replica_0]" in out and "[replica_1]" in out, out
+    metrics.reset()
     print("dump_metrics selftest: OK")
     return 0
 
@@ -536,11 +617,11 @@ def main(argv=None) -> int:
             return 2
         return to_chrome(argv[1], argv[2])
     if argv[0] == "--watch":
-        if len(argv) not in (2, 3):
-            print("usage: dump_metrics --watch <interval_s> [telemetry_dir]",
-                  file=sys.stderr)
+        if len(argv) < 2:
+            print("usage: dump_metrics --watch <interval_s> "
+                  "[telemetry_dir ...]", file=sys.stderr)
             return 2
-        return watch(float(argv[1]), argv[2] if len(argv) == 3 else None)
+        return watch(float(argv[1]), argv[2:] if len(argv) > 2 else None)
     if len(argv) != 1:
         print(__doc__.strip(), file=sys.stderr)
         return 2

@@ -1,0 +1,419 @@
+"""Fleet-serving bench driver + CI smoke.
+
+    python -m tools.fleet_bench --selftest
+        <30s, JAX_PLATFORMS=cpu, in-process + subprocess replicas:
+        exercises the full fleet contract — exactly-once accounting,
+        prefix affinity, health-aware routing (degraded replicas get no
+        new traffic), SIGKILL requeue with bit-identical seeded replay,
+        rolling restart with zero rejected-by-bug, near-linear QPS
+        scaling 1 -> 4 sim replicas over the worker protocol, a real
+        ServingEngine prefix-cache leg (reduced prefill dispatches vs
+        cold), the fleet/* registry, and the run-ledger/perf-gate
+        mechanics. The smoke-gate entry (ROADMAP).
+
+    python -m tools.fleet_bench [--requests N] [--replicas "1,2,4"]
+                                [--step-ms MS] [--slots S]
+        Fleet bench on this host: per-replica-count QPS over the
+        process-worker protocol (sleep-based sim engines modeling a
+        device-bound accelerator — the router/protocol scaling is the
+        thing measured), plus a real-engine shared-system-prompt leg
+        (cold vs warm prefix cache). Prints JSON (per-count QPS, fleet
+        snapshot, prefix hit rate); appends one run-ledger record per
+        replica count via monitor.runlog (armed by PADDLE_TPU_RUN_LEDGER)
+        so tools/perf_gate --check gates fleet QPS like every other bench.
+
+Scaling is measured with SIM engines in REAL worker processes: each sim
+step sleeps its ``step_ms`` like a host blocked on a device dispatch, so
+replicas overlap wall-clock the way TPU replicas would, even on a 1-core
+CI host where real compute cannot parallelize. Every correctness leg
+(kill, requeue, prefix, restart) runs real code paths — only the decode
+arithmetic is simulated in the scaling leg.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from paddle_tpu.monitor.metrics import sorted_percentile  # noqa: E402
+
+
+def _sim_spec(slots: int, step_ms: float) -> dict:
+    return {"engine": "sim", "sim": {"slots": slots, "step_ms": step_ms}}
+
+
+def run_scaling_leg(n_replicas: int, n_requests: int = 96,
+                    step_ms: float = 4.0, slots: int = 4,
+                    max_new: int = 16, telemetry_base: str = None) -> dict:
+    """Drive ``n_requests`` through ``n_replicas`` process workers (sim
+    engines); returns the throughput digest the ledger gates."""
+    from paddle_tpu.fleet import FleetConfig, Router
+
+    router = Router(FleetConfig(
+        replicas=n_replicas, mode="process", affinity="round_robin",
+        engine_spec=_sim_spec(slots, step_ms), max_outstanding=slots * 2,
+        telemetry_base=telemetry_base))
+    try:
+        t0 = time.perf_counter()
+        frs = [router.submit([1, 2, i % 13], max_new)
+               for i in range(n_requests)]
+        ok = router.wait_all(120.0)
+        dt = time.perf_counter() - t0
+        acc = router.accounting()
+        bad = {k: v for k, v in acc.items() if v != "finished"}
+        assert ok and not bad, "scaling leg dropped requests: %s" % bad
+        lat = sorted(f.latency_s * 1e3 for f in frs)
+        snap = router.snapshot()
+        return {"replicas": n_replicas, "requests": n_requests,
+                "qps": round(n_requests / dt, 3),
+                "tokens_per_sec": round(
+                    sum(len(f.tokens) for f in frs) / dt, 1),
+                "p50_ms": round(sorted_percentile(lat, 50), 3),
+                "p99_ms": round(sorted_percentile(lat, 99), 3),
+                "wall_s": round(dt, 3),
+                "streams": [f.tokens for f in frs],
+                "snapshot": snap}
+    finally:
+        router.close()
+
+
+def run_prefix_leg(n_requests: int = 8, prefix_pages: int = 8) -> dict:
+    """Shared-system-prompt stream through a real ServingEngine, cold vs
+    warm prefix cache: the warm pass must serve the shared prefix from
+    cached KV pages (fewer prefill dispatches, hits > 0) and generate the
+    SAME tokens."""
+    from paddle_tpu.fleet import metrics as fm
+    from paddle_tpu.models.decoder_lm import DecoderConfig, DecoderLM
+    from paddle_tpu.serving import metrics as sm
+    from paddle_tpu.serving.engine import ServingConfig, ServingEngine
+
+    mcfg = DecoderConfig(vocab_size=64, n_layer=1, d_model=16, n_head=2,
+                         max_seq=64)
+    model = DecoderLM(mcfg, seed=7)
+    system_prompt = list(range(1, 17))  # 16 tokens = 2 pages of 8
+
+    def drive(cache_pages: int) -> tuple:
+        eng = ServingEngine(model, ServingConfig(
+            slots=2, page_size=8, max_seq=64, num_pages=32,
+            prefix_cache_pages=cache_pages))
+        p0 = sm.PREFILL_COUNT.value
+        outs = []
+        t0 = time.perf_counter()
+        for i in range(n_requests):
+            req = eng.submit(system_prompt + [20 + i, 21 + i], 6,
+                             temperature=0.7, seed=500 + i)
+            eng.run()
+            assert req.state == "finished", req
+            outs.append(list(req.tokens_out))
+        dt = time.perf_counter() - t0
+        prefills = int(sm.PREFILL_COUNT.value - p0)
+        assert eng.page_accounting_ok(), "page accounting broken"
+        eng.drain(10.0)
+        assert eng.pool.num_used == 0, "pages leaked through drain"
+        return outs, prefills, dt
+
+    h0, m0 = fm.PREFIX_HITS.value, fm.PREFIX_MISSES.value
+    outs_cold, prefills_cold, _ = drive(0)
+    outs_warm, prefills_warm, _ = drive(prefix_pages)
+    hits = int(fm.PREFIX_HITS.value - h0)
+    misses = int(fm.PREFIX_MISSES.value - m0)
+    assert outs_warm == outs_cold, \
+        "prefix-cache hits changed the generated streams"
+    assert hits > 0, "warm pass produced no prefix hits"
+    assert prefills_warm < prefills_cold, (prefills_warm, prefills_cold)
+    return {"requests": n_requests,
+            "prefill_dispatches_cold": prefills_cold,
+            "prefill_dispatches_warm": prefills_warm,
+            "prefix_hits": hits, "prefix_misses": misses,
+            "hit_rate": round(hits / max(1, hits + misses), 3)}
+
+
+# -- selftest -----------------------------------------------------------------
+def _selftest_mechanics() -> None:
+    """In-process sim fleet: exactly-once, affinity, health-aware
+    dispatch."""
+    from paddle_tpu.fleet import (FleetConfig, Router, SimConfig, SimEngine,
+                                  prefix_key)
+    from paddle_tpu.fleet import metrics as fm
+
+    engines = {}
+
+    def factory(i):
+        engines[i] = SimEngine(SimConfig(slots=2))
+        return engines[i]
+
+    router = Router(FleetConfig(replicas=3, mode="inprocess",
+                                affinity="prefix", affinity_tokens=4,
+                                engine_factory=factory))
+    # prefix affinity: same window -> same replica (before any degradation)
+    window = [5, 6, 7, 8]
+    expect = int(prefix_key(window)[:8], 16) % 3
+    frs = [router.submit(window + [i], 4) for i in range(6)]
+    assert router.wait_all(20.0)
+    assert all(f.state == "finished" for f in frs)
+    assert all(f.last_replica == expect for f in frs), \
+        "prefix affinity scattered a cohort"
+    # health-aware: degrade that replica; the cohort must route elsewhere
+    engines[expect].force_degraded = True
+    frs2 = [router.submit(window + [90 + i], 4) for i in range(4)]
+    assert router.wait_all(20.0)
+    assert all(f.state == "finished" for f in frs2)
+    assert all(f.last_replica != expect for f in frs2), \
+        "a degraded replica was fed new traffic"
+    # exactly-once: every id has exactly one terminal state
+    acc = router.accounting()
+    assert len(acc) == 10 and set(acc.values()) == {"finished"}, acc
+    dup0 = fm.DUPLICATE_RESULTS.value
+    router.close()
+    assert fm.DUPLICATE_RESULTS.value == dup0
+
+
+def _selftest_kill_replay() -> None:
+    """In-process SIGKILL analog: requeue + bit-identical seeded replay
+    vs an unkilled twin."""
+    from paddle_tpu.fleet import FleetConfig, Router, SimConfig, SimEngine
+    from paddle_tpu.fleet import metrics as fm
+
+    def cfg(n):
+        return FleetConfig(replicas=n, mode="inprocess",
+                           affinity="round_robin",
+                           engine_factory=lambda i: SimEngine(
+                               SimConfig(slots=1)))
+
+    req0 = fm.REQUEUED.value
+    router = Router(cfg(2))
+    frs = [router.submit([9, 9, i], 8, temperature=0.7) for i in range(8)]
+    for _ in range(3):
+        router.pump()
+    router._replicas[0].kill()  # mid-traffic loss
+    assert router.wait_all(20.0)
+    acc = router.accounting()
+    assert set(acc.values()) == {"finished"}, "silent drop/failure: %s" % acc
+    assert fm.REQUEUED.value > req0, "kill lost no in-flight work?"
+    twin = Router(cfg(1))
+    frs_t = [twin.submit([9, 9, i], 8, temperature=0.7) for i in range(8)]
+    assert twin.wait_all(20.0)
+    assert [f.tokens for f in frs] == [f.tokens for f in frs_t], \
+        "requeued replay diverged from the unkilled twin"
+    router.close()
+    twin.close()
+
+
+def _selftest_process_kill() -> None:
+    """The real thing: SIGKILL a worker process mid-traffic; exactly-once
+    + bit-identical replay must hold across the pipe protocol."""
+    from paddle_tpu.fleet import FleetConfig, Router
+    from paddle_tpu.fleet import metrics as fm
+
+    spec = _sim_spec(slots=2, step_ms=3.0)
+    router = Router(FleetConfig(replicas=2, mode="process",
+                                affinity="round_robin", engine_spec=spec,
+                                max_outstanding=4))
+    frs = [router.submit([7, i], 12, temperature=0.5) for i in range(16)]
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline \
+            and not router._replicas[0].inflight:
+        router.pump()
+        time.sleep(0.002)
+    assert router._replicas[0].inflight, "no traffic reached the victim"
+    r0 = fm.REPLICA_RESTARTS.value
+    router._replicas[0].kill()
+    assert router.wait_all(60.0)
+    acc = router.accounting()
+    assert set(acc.values()) == {"finished"}, "silent drop: %s" % acc
+    assert fm.REPLICA_RESTARTS.value > r0, "dead worker not respawned"
+    twin = Router(FleetConfig(replicas=1, mode="process", engine_spec=spec,
+                              max_outstanding=4))
+    frs_t = [twin.submit([7, i], 12, temperature=0.5) for i in range(16)]
+    assert twin.wait_all(60.0)
+    assert [f.tokens for f in frs] == [f.tokens for f in frs_t], \
+        "SIGKILL replay diverged from the unkilled twin"
+    router.close()
+    twin.close()
+
+
+def _selftest_rolling_restart() -> None:
+    """Rolling restart under traffic: zero rejected-by-bug terminal
+    states, all requests finish."""
+    from paddle_tpu.fleet import FleetConfig, Router
+
+    spec = _sim_spec(slots=2, step_ms=2.0)
+    router = Router(FleetConfig(replicas=2, mode="process", engine_spec=spec,
+                                max_outstanding=4))
+    frs = [router.submit([5, i], 10) for i in range(12)]
+    for _ in range(10):
+        router.pump()
+        time.sleep(0.002)
+    router.rolling_restart(15.0)
+    assert router.wait_all(60.0)
+    acc = router.accounting()
+    assert set(acc.values()) == {"finished"}, \
+        "rolling restart rejected/lost requests: %s" % acc
+    assert all(f.tokens for f in frs)
+    router.close()
+
+
+def selftest() -> int:
+    t0 = time.perf_counter()
+    from paddle_tpu.monitor import metrics as mx
+
+    mx.enable()
+    _selftest_mechanics()
+    _selftest_kill_replay()
+    _selftest_process_kill()
+    _selftest_rolling_restart()
+
+    # scaling: 1 vs 4 sim-engine workers over the real worker protocol.
+    # identical streams at every width (seeded, position-keyed), and >=3x
+    # QPS at 4 replicas (ISSUE 15 acceptance bar)
+    with tempfile.TemporaryDirectory() as td:
+        leg1 = run_scaling_leg(1, telemetry_base=os.path.join(td, "f1"))
+        leg4 = run_scaling_leg(4, telemetry_base=os.path.join(td, "f4"))
+        scale = leg4["qps"] / leg1["qps"]
+        assert leg1["streams"] == leg4["streams"], \
+            "token streams depend on replica count"
+        assert scale >= 3.0, \
+            "QPS scaling 1->4 replicas = %.2fx (< 3.0x)" % scale
+        snap = leg4["snapshot"]
+        assert len(snap["replicas"]) == 4
+        assert all(r["completed"] > 0 for r in snap["replicas"]), \
+            "a replica served nothing: %s" % snap["replicas"]
+        assert all(r["p99_ms"] is not None for r in snap["replicas"])
+        # per-replica telemetry rings, merged into one fleet view: every
+        # worker flushes a final sample when the router closes it
+        from paddle_tpu.fleet import aggregate_telemetry
+
+        tele = aggregate_telemetry(os.path.join(td, "f4"))
+        assert len(tele) == 4, "expected 4 replica rings: %s" % list(tele)
+        assert all(v["samples"] >= 1 for v in tele.values()), tele
+
+    prefix = run_prefix_leg()
+
+    # fleet/* registry: the full instrument set must be live
+    import paddle_tpu.fleet.metrics  # noqa: F401
+
+    reg = mx.snapshot()
+    for name in ("fleet/submitted", "fleet/routed", "fleet/requeued",
+                 "fleet/completed", "fleet/replica_restarts",
+                 "fleet/queue_depth", "fleet/prefix_cache/hits",
+                 "fleet/prefix_cache/evictions",
+                 "fleet/prefix_cache/poisoned_skipped"):
+        assert name in reg, "missing fleet instrument %s" % name
+
+    # run-ledger + perf-gate mechanics on a throwaway ledger: one config
+    # per replica count, steady records gate NEUTRAL/IMPROVED
+    from paddle_tpu.monitor import runlog
+    from tools import perf_gate
+
+    led = os.path.join(tempfile.mkdtemp(prefix="fleet_ledger_"),
+                       "ledger.jsonl")
+    prev_env = os.environ.get("PADDLE_TPU_RUN_LEDGER")
+    os.environ["PADDLE_TPU_RUN_LEDGER"] = led
+    try:
+        configs = {}
+        for leg in (leg1, leg4):
+            configs["fleet_r%d" % leg["replicas"]] = {
+                k: v for k, v in leg.items()
+                if isinstance(v, (int, float))}
+        configs["fleet_prefix"] = {k: v for k, v in prefix.items()
+                                   if isinstance(v, (int, float))}
+        for _ in range(5):
+            rec = runlog.record_run("fleet_bench", configs)
+        assert rec.get("ledger_path") == led
+        assert len(runlog.read_ledger(led)) == 5
+        code, verdicts = perf_gate.check_ledger(path=led, quiet=True)
+        assert code == 0, "perf gate flagged identical runs: exit %d" % code
+        bad = [v for v in verdicts
+               if v.verdict not in ("NEUTRAL", "IMPROVED")]
+        assert not bad, bad
+    finally:
+        if prev_env is None:
+            os.environ.pop("PADDLE_TPU_RUN_LEDGER", None)
+        else:
+            os.environ["PADDLE_TPU_RUN_LEDGER"] = prev_env
+
+    print("fleet_bench selftest: OK (%.1fs)  scaling 1->4 = %.2fx "
+          "(qps %.0f -> %.0f); prefix hit_rate=%.2f prefills %d -> %d"
+          % (time.perf_counter() - t0, scale, leg1["qps"], leg4["qps"],
+             prefix["hit_rate"], prefix["prefill_dispatches_cold"],
+             prefix["prefill_dispatches_warm"]))
+    return 0
+
+
+def fleet_bench(n_requests: int = 96, replica_counts=(1, 2, 4),
+                step_ms: float = 4.0, slots: int = 4) -> dict:
+    """The bench body ``--selftest`` does NOT run: per-replica-count QPS
+    legs + the real-engine prefix leg, as one JSON digest."""
+    from paddle_tpu.monitor import metrics as mx
+
+    mx.enable()
+    res = {"host_cpus": os.cpu_count(), "step_ms": step_ms, "slots": slots}
+    legs = {}
+    for n in replica_counts:
+        leg = run_scaling_leg(n, n_requests=n_requests, step_ms=step_ms,
+                              slots=slots)
+        leg.pop("streams", None)  # bulky; identical across counts anyway
+        legs["replicas_%d" % n] = leg
+    res["scaling"] = legs
+    base = legs.get("replicas_%d" % replica_counts[0])
+    top = legs.get("replicas_%d" % replica_counts[-1])
+    if base and top:
+        res["qps_scale"] = round(top["qps"] / base["qps"], 3)
+    res["prefix"] = run_prefix_leg()
+    return res
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 0
+    if argv and argv[0] == "--selftest":
+        return selftest()
+    kw = {}
+    it = iter(argv)
+    for a in it:
+        key = a.lstrip("-").replace("-", "_")
+        if key == "replicas":
+            kw["replica_counts"] = tuple(
+                int(x) for x in next(it).split(","))
+        elif key == "requests":
+            kw["n_requests"] = int(next(it))
+        elif key == "step_ms":
+            kw["step_ms"] = float(next(it))
+        elif key == "slots":
+            kw["slots"] = int(next(it))
+        else:
+            print("unknown flag %r" % a, file=sys.stderr)
+            return 2
+    res = fleet_bench(**kw)
+    try:
+        # one ledger record per replica count (plus the prefix leg), so
+        # perf_gate --check gates fleet QPS per width like every other
+        # bench kind (armed via PADDLE_TPU_RUN_LEDGER)
+        from paddle_tpu.monitor import runlog
+
+        for name, leg in res["scaling"].items():
+            cfg = {k: v for k, v in leg.items()
+                   if isinstance(v, (int, float))}
+            runlog.record_run("fleet_bench",
+                              {"fleet_%s" % name: cfg,
+                               "fleet_prefix": {
+                                   k: v for k, v in res["prefix"].items()
+                                   if isinstance(v, (int, float))}})
+        res.update(runlog.tail_info())
+    except Exception as e:
+        res["run_ledger_error"] = repr(e)[:80]
+    print(json.dumps(res, indent=1, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

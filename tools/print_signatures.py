@@ -52,6 +52,11 @@ MODULES = [
     "paddle_tpu.contrib",
     "paddle_tpu.inference",
     "paddle_tpu.serving",
+    "paddle_tpu.fleet",
+    "paddle_tpu.fleet.prefix_cache",
+    "paddle_tpu.fleet.protocol",
+    "paddle_tpu.fleet.replica",
+    "paddle_tpu.fleet.router",
     "paddle_tpu.reliability",
     "paddle_tpu.reliability.faults",
     "paddle_tpu.reliability.supervisor",
