@@ -8,16 +8,25 @@ subprocesses speaking the length-prefixed frame protocol in production
 shape — with health-aware routing, session/prefix affinity, a bounded
 LRU prefix cache of prefilled KV pages, kill-tolerant exactly-once
 request accounting, and per-replica telemetry aggregated into one fleet
-snapshot. See ROADMAP item 2 and tools/fleet_bench.py.
+snapshot — plus the fleet observability plane: cross-process
+distributed tracing with clock-aligned merge (:mod:`.trace`,
+tools/fleet_trace.py), two-scope SLO evaluation over the telemetry
+rings (:mod:`.slo`) and the run-stamped fleet event journal
+(:mod:`.events`). See ROADMAP item 2, tools/fleet_bench.py and
+tools/fleet_top.py.
 """
 
 from . import metrics  # registers every fleet/* instrument
+from .events import FleetEventLog, read_events
 from .prefix_cache import PrefixCache, PrefixEntry, prefix_key
 from .protocol import FrameReader, read_frame, send_frame
 from .replica import (InProcessReplica, ProcessReplica, SimConfig,
                       SimEngine, sim_token)
 from .router import (FleetBackpressure, FleetConfig, FleetRequest, Router,
                      aggregate_telemetry)
+from .slo import FleetSLO, fleet_slos_from_env, merge_fleet_docs
+from .trace import (close_orphans, fleet_request_spans, load_fragments,
+                    validate_fleet_spans)
 
 __all__ = [
     "Router", "FleetConfig", "FleetRequest", "FleetBackpressure",
@@ -26,5 +35,9 @@ __all__ = [
     "InProcessReplica", "ProcessReplica", "SimConfig", "SimEngine",
     "sim_token",
     "FrameReader", "read_frame", "send_frame",
+    "FleetEventLog", "read_events",
+    "FleetSLO", "fleet_slos_from_env", "merge_fleet_docs",
+    "close_orphans", "fleet_request_spans", "load_fragments",
+    "validate_fleet_spans",
     "metrics",
 ]

@@ -1,0 +1,109 @@
+"""Structured fleet event log: one JSONL line per lifecycle event.
+
+The router's flight journal — the third leg of the fleet observability
+plane next to the telemetry rings (continuous numbers) and the merged
+trace (per-request timelines). Every line is one JSON object::
+
+    {"schema": "paddle_tpu.fleet_events/v1", "t": <unix time>,
+     "run_id": "<monitor.runlog.run_id()>", "kind": "<event>", ...}
+
+Kinds the router emits today: ``fleet_start``/``fleet_stop``, ``spawn``,
+``kill_detected``, ``requeue``, ``reroute``, ``drain``, ``restart``,
+``rolling_restart``, ``slo_breach``/``slo_clear``. The vocabulary is
+open — the SLO-driven autoscaler (ROADMAP item 3) will add ``scale``
+events through the same writer. Request-scoped events carry
+``trace_id`` and replica-scoped ones ``replica``, so ledger records,
+flight dumps, telemetry windows, and the merged Perfetto trace all join
+on shared keys (``run_id`` across artifacts, ``trace_id`` across a
+request's attempts).
+
+Flight-recorder durability rule: every ``emit`` is one line + flush, so
+a SIGKILLed router loses at most the line being written; ``read_events``
+skips a torn tail instead of failing — the log is a post-mortem artifact
+first.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ..monitor import runlog as _runlog
+
+__all__ = ["FleetEventLog", "read_events", "EVENT_SCHEMA"]
+
+EVENT_SCHEMA = "paddle_tpu.fleet_events/v1"
+
+
+class FleetEventLog:
+    """Append-only JSONL event writer. Write failures disable the log
+    (observability must never take the fleet down with it)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fp = None
+        try:
+            d = os.path.dirname(os.path.abspath(path))
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._fp = open(path, "a")
+        except OSError:
+            self._fp = None
+
+    @property
+    def armed(self) -> bool:
+        return self._fp is not None
+
+    def emit(self, kind: str, **fields: Any) -> Optional[dict]:
+        """One event line; returns the doc written (None when disarmed).
+        Non-JSON-serializable field values degrade to ``repr``."""
+        if self._fp is None:
+            return None
+        doc: Dict[str, Any] = {"schema": EVENT_SCHEMA, "t": time.time(),
+                               "run_id": _runlog.run_id(), "kind": str(kind)}
+        doc.update(fields)
+        try:
+            line = json.dumps(doc, default=repr)
+        except (TypeError, ValueError):
+            return None
+        try:
+            self._fp.write(line + "\n")
+            self._fp.flush()
+        except OSError:
+            self.close()
+            return None
+        return doc
+
+    def close(self) -> None:
+        fp, self._fp = self._fp, None
+        if fp is not None:
+            try:
+                fp.close()
+            except OSError:
+                pass
+
+
+def read_events(path: str, kind: Optional[str] = None) -> List[dict]:
+    """Load the event log back (optionally one ``kind`` only). Torn or
+    foreign trailing lines are skipped, not fatal."""
+    out: List[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue  # torn tail
+                if doc.get("schema") != EVENT_SCHEMA:
+                    continue
+                if kind is not None and doc.get("kind") != kind:
+                    continue
+                out.append(doc)
+    except OSError:
+        pass
+    return out

@@ -39,6 +39,9 @@ import sys
 import time
 from typing import Any, Dict, List, Optional
 
+from ..monitor import tracer as _tracer
+from ..reliability import faults as _faults
+from ..serving import metrics as _sm
 from ..serving.request import (FAILED, FINISHED, REJECTED, BackpressureError,
                                DrainingError, Request)
 from .protocol import FrameReader, send_frame
@@ -91,14 +94,17 @@ class SimEngine:
 
     # -- the engine contract --------------------------------------------------
     def submit(self, prompt, max_new_tokens, deadline_s=None,
-               temperature=0.0, top_k=0, seed=None) -> Request:
+               temperature=0.0, top_k=0, seed=None, trace_id=None,
+               attempt=0) -> Request:
         if self._draining:
             raise DrainingError("sim engine is draining")
         if len(self._queue) >= self.cfg.max_queue:
             raise BackpressureError("sim queue full")
         req = Request(prompt, max_new_tokens, deadline_s=deadline_s,
-                      temperature=temperature, top_k=top_k, seed=seed)
+                      temperature=temperature, top_k=top_k, seed=seed,
+                      trace_id=trace_id, attempt=attempt)
         self._queue.append(req)
+        _sm.REQUESTS_SUBMITTED.inc()
         return req
 
     def idle(self) -> bool:
@@ -120,8 +126,14 @@ class SimEngine:
             self._emit(req)
             req.first_token_t = time.perf_counter()
             self._running.append(req)
+            _sm.REQUESTS_ADMITTED.inc()
+        _sm.QUEUE_DEPTH.set(len(self._queue))
         if not self._running:
             return finished
+        # same chaos chokepoint as the real decode loop: a ``latency``
+        # fault sleeps here, so per-replica fault plans can degrade one
+        # sim replica's tail without touching its peers
+        _faults.fire("serving.decode")
         if self.cfg.step_ms > 0:
             time.sleep(self.cfg.step_ms / 1e3)
         self.steps += 1
@@ -133,6 +145,9 @@ class SimEngine:
                 req.state = FINISHED
                 req.finished_t = time.perf_counter()
                 finished.append(req)
+                _sm.REQUESTS_RETIRED.inc()
+                _sm.REQUEST_LATENCY_MS.observe(
+                    (req.finished_t - req.submitted_t) * 1e3)
             else:
                 still.append(req)
         self._running = still
@@ -219,7 +234,9 @@ class InProcessReplica:
                 rdoc["prompt"], rdoc["max_new_tokens"],
                 deadline_s=rdoc.get("deadline_s"),
                 temperature=rdoc.get("temperature", 0.0),
-                top_k=rdoc.get("top_k", 0), seed=rdoc.get("seed"))
+                top_k=rdoc.get("top_k", 0), seed=rdoc.get("seed"),
+                trace_id=rdoc.get("trace_id"),
+                attempt=int(rdoc.get("attempt", 0)))
         except DrainingError:
             self._events.append({"ev": "result", "id": rdoc["id"],
                                  "state": REJECTED, "kind": "draining"})
@@ -317,6 +334,7 @@ class ProcessReplica:
 
     def __init__(self, spec: dict, index: int = 0,
                  telemetry_dir: Optional[str] = None,
+                 trace_file: Optional[str] = None,
                  ready_timeout_s: float = 120.0):
         self.spec = dict(spec)
         self.index = int(index)
@@ -326,6 +344,9 @@ class ProcessReplica:
         self._events: List[dict] = []
         self._dead = False
         self.pid: Optional[int] = None
+        self.trace_file = trace_file
+        self.clock_offset_us = 0   # worker span clock − router span clock
+        self.clock_rtt_us = 0      # min handshake round trip (error bound)
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
         env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -335,6 +356,15 @@ class ProcessReplica:
         else:
             # never let N workers share the parent's ring dir by accident
             env.pop("PADDLE_TPU_TELEMETRY_DIR", None)
+        if trace_file:
+            d = os.path.dirname(os.path.abspath(trace_file))
+            if d:
+                os.makedirs(d, exist_ok=True)
+            env["PADDLE_TPU_TRACE_FILE"] = trace_file
+        else:
+            # N workers inheriting the parent's trace file would clobber
+            # each other's fragment — arm per-replica or not at all
+            env.pop("PADDLE_TPU_TRACE_FILE", None)
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "paddle_tpu.fleet.worker"],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env)
@@ -342,6 +372,7 @@ class ProcessReplica:
         self.reader = FrameReader(self.proc.stdout.fileno())
         send_frame(self.proc.stdin, {"op": "spec", "spec": self.spec})
         self._wait_ready(ready_timeout_s)
+        self._clock_sync()
 
     def _wait_ready(self, timeout_s: float) -> None:
         deadline = time.monotonic() + timeout_s
@@ -359,6 +390,45 @@ class ProcessReplica:
         self.kill()
         raise RuntimeError("fleet worker %d not ready after %.0fs"
                            % (self.index, timeout_s))
+
+    def _clock_sync(self, probes: int = 3, timeout_s: float = 5.0) -> None:
+        """Measure this worker's span-clock offset with an NTP-style
+        midpoint handshake: offset = worker_t − (t0+t1)/2, keeping the
+        probe with the smallest round trip (its midpoint estimate has the
+        tightest error bound, ±rtt/2). Runs AFTER ready — probing during
+        engine build would fold warmup time into the midpoint. The
+        offsets land in the trace-dir manifest so the merge can move
+        every worker fragment onto the router's clock."""
+        best_rtt = None
+        best_off = 0
+        for _ in range(probes):
+            t0 = _tracer.now_us()
+            if not self._send({"op": "clock"}):
+                break
+            reply = None
+            t1 = t0
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                evs = self.reader.drain()
+                t1 = _tracer.now_us()
+                for ev in evs:
+                    if ev.get("ev") == "clock" and reply is None:
+                        reply = ev
+                    else:
+                        self._events.append(ev)
+                if reply is not None:
+                    break
+                if self.reader.eof or self.proc.poll() is not None:
+                    break
+                time.sleep(0.001)
+            if reply is None:
+                break
+            rtt = max(1, t1 - t0)
+            off = int(reply.get("t_us", 0)) - (t0 + t1) // 2
+            if best_rtt is None or rtt < best_rtt:
+                best_rtt, best_off = rtt, off
+        self.clock_offset_us = int(best_off)
+        self.clock_rtt_us = int(best_rtt or 0)
 
     @property
     def alive(self) -> bool:

@@ -36,18 +36,29 @@ drive-loop shape as ``ServingEngine.step``.
 
 from __future__ import annotations
 
+import itertools
+import json
 import os
 import time
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional, Sequence
 
+from ..monitor import runlog as _runlog
+from ..monitor import tracer as _tr
 from ..serving.request import FAILED, FINISHED, REJECTED, TIMEOUT
 from . import metrics as _fm
+from . import trace as _ftr
+from .events import FleetEventLog
 from .prefix_cache import prefix_key
 from .replica import InProcessReplica, ProcessReplica
+from .slo import FleetSLO, fleet_slos_from_env
 
 __all__ = ["FleetConfig", "FleetRequest", "FleetBackpressure", "Router",
            "aggregate_telemetry"]
+
+# distinguishes trace ids of two Routers in one process (the chaos
+# drill's replay twin must never collide with the original's ids)
+_ROUTER_SEQ = itertools.count()
 
 _TERMINAL = (FINISHED, FAILED, TIMEOUT, REJECTED)
 
@@ -66,11 +77,13 @@ class FleetRequest:
 
     __slots__ = ("id", "prompt", "max_new_tokens", "deadline_s",
                  "temperature", "top_k", "seed", "state", "tokens", "error",
-                 "attempts", "last_replica", "submitted_t", "finished_t")
+                 "attempts", "last_replica", "submitted_t", "finished_t",
+                 "trace_id", "dispatches", "dispatched_t", "queued_since")
 
     def __init__(self, rid: int, prompt: Sequence[int], max_new_tokens: int,
                  deadline_s: Optional[float] = None, temperature: float = 0.0,
-                 top_k: int = 0, seed: Optional[int] = None):
+                 top_k: int = 0, seed: Optional[int] = None,
+                 trace_id: Optional[str] = None):
         self.id = int(rid)
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -88,6 +101,12 @@ class FleetRequest:
         self.last_replica: Optional[int] = None
         self.submitted_t = time.perf_counter()
         self.finished_t: Optional[float] = None
+        # tracing: one trace_id across every attempt of this request;
+        # ``dispatches`` is the 1-based attempt number the spans carry
+        self.trace_id = trace_id if trace_id else "fr-%d" % self.id
+        self.dispatches = 0
+        self.dispatched_t: Optional[float] = None   # open attempt start
+        self.queued_since: Optional[float] = self.submitted_t
 
     @property
     def terminal(self) -> bool:
@@ -100,12 +119,16 @@ class FleetRequest:
         return self.finished_t - self.submitted_t
 
     def doc(self) -> dict:
-        """The wire/replica form of this request."""
+        """The wire/replica form of this request. ``attempt`` is the
+        current dispatch count, so the replica's engine stamps its spans
+        with the attempt they belong to (a requeued replay is attempt 2
+        of the SAME trace_id)."""
         return {"id": self.id, "prompt": self.prompt,
                 "max_new_tokens": self.max_new_tokens,
                 "deadline_s": self.deadline_s,
                 "temperature": self.temperature, "top_k": self.top_k,
-                "seed": self.seed}
+                "seed": self.seed, "trace_id": self.trace_id,
+                "attempt": self.dispatches}
 
     def __repr__(self):
         return ("FleetRequest(id=%d, state=%s, out=%d, attempts=%d)"
@@ -126,6 +149,23 @@ class FleetConfig:
     bounds replays per request before it terminally FAILs ("replica
     lost"). ``telemetry_base``: per-replica telemetry ring dirs are
     created under it (``replica_<i>/``) in process mode.
+
+    Observability plane (all default-off; env fallbacks make every tool
+    armable without code changes):
+
+    * ``trace_dir`` (env ``PADDLE_TPU_FLEET_TRACE_DIR``) — distributed
+      tracing: the router runs the host tracer, workers get per-spawn
+      fragment files + a clock-offset handshake, and ``close()`` writes
+      the fragments manifest ``tools/fleet_trace.py`` merges;
+    * ``slos`` (env ``PADDLE_TPU_FLEET_SLO``, ``monitor.slo`` grammar) —
+      evaluated per replica AND fleet-aggregate over the telemetry rings
+      (needs ``telemetry_base``); a replica in breach is drained of new
+      traffic like any degraded replica;
+    * ``event_log`` (env ``PADDLE_TPU_FLEET_EVENTS``) — JSONL fleet
+      lifecycle journal (fleet.events);
+    * ``spec_overrides`` — {replica index: spec keys merged over
+      ``engine_spec`` for that replica} (process mode), e.g. a per-replica
+      ``fault_plan`` for chaos drills.
     """
 
     def __init__(self, replicas=2, mode: str = "inprocess",
@@ -136,7 +176,11 @@ class FleetConfig:
                  engine_spec: Optional[dict] = None,
                  auto_restart: bool = True,
                  telemetry_base: Optional[str] = None,
-                 health_every: int = 16):
+                 health_every: int = 16,
+                 trace_dir: Optional[str] = None,
+                 slos: Optional[Sequence] = None,
+                 event_log: Optional[str] = None,
+                 spec_overrides: Optional[Dict[int, dict]] = None):
         if mode not in ("inprocess", "process"):
             raise ValueError("mode must be 'inprocess' or 'process'")
         if affinity not in ("prefix", "round_robin"):
@@ -159,6 +203,14 @@ class FleetConfig:
         self.auto_restart = bool(auto_restart)
         self.telemetry_base = telemetry_base
         self.health_every = max(1, int(health_every))
+        if trace_dir is None:
+            trace_dir = os.environ.get("PADDLE_TPU_FLEET_TRACE_DIR") or None
+        self.trace_dir = trace_dir
+        self.slos = list(slos) if slos is not None else fleet_slos_from_env()
+        if event_log is None:
+            event_log = os.environ.get("PADDLE_TPU_FLEET_EVENTS") or None
+        self.event_log = event_log
+        self.spec_overrides = dict(spec_overrides or {})
         if mode == "inprocess" and engine_factory is None:
             raise ValueError("inprocess mode needs engine_factory")
         if mode == "process" and engine_spec is None:
@@ -185,6 +237,7 @@ class Router:
 
     def __init__(self, config: FleetConfig):
         self.cfg = config
+        self._seq = next(_ROUTER_SEQ)
         self._queue: Deque[FleetRequest] = deque()
         self._requests: Dict[int, FleetRequest] = {}
         self._next_id = 0
@@ -196,26 +249,106 @@ class Router:
         self._health: Dict[int, dict] = {}       # replica index -> last doc
         self._rep_done: Dict[int, int] = {}      # replica index -> completed
         self._rep_lat: Dict[int, List[float]] = {}
+        # -- observability plane --------------------------------------------
+        self._trace = bool(config.trace_dir)
+        self._own_tracer = False
+        self._spawn_gen: Dict[int, int] = {}     # replica -> spawn count
+        self._worker_frags: List[dict] = []      # manifest worker entries
+        if self._trace:
+            os.makedirs(config.trace_dir, exist_ok=True)
+            if not _tr.active():
+                _tr.start_tracing()
+                self._own_tracer = True
+        self._events = (FleetEventLog(config.event_log)
+                        if config.event_log else None)
+        self._slo_breached: Dict[int, dict] = {}  # replica -> last breach doc
+        self._fleet_breach: Optional[dict] = None
+        self._fleet_breach_count = 0
+        self._slo: Optional[FleetSLO] = None
+        if config.slos and config.telemetry_base:
+            self._slo = FleetSLO(
+                config.slos,
+                on_replica_breach=self._on_replica_slo_breach,
+                on_replica_clear=self._on_replica_slo_clear,
+                on_fleet_breach=self._on_fleet_slo_breach,
+                on_fleet_clear=self._on_fleet_slo_clear)
+        self._last_obs_t = 0.0   # throttles ring reads + snapshot writes
         self._replicas = [self._spawn(i) for i in range(self.cfg.replicas)]
         _fm.REPLICAS_ALIVE.set(len(self._replicas))
+        self._emit_event("fleet_start", replicas=self.cfg.replicas,
+                         mode=self.cfg.mode, trace_dir=self.cfg.trace_dir,
+                         telemetry_base=self.cfg.telemetry_base)
+
+    # -- observability callbacks/sinks ----------------------------------------
+    def _emit_event(self, kind: str, **fields) -> None:
+        if self._events is not None:
+            self._events.emit(kind, **fields)
+
+    def _on_replica_slo_breach(self, index: int, breach) -> None:
+        doc = breach.to_doc()
+        self._slo_breached[index] = doc
+        self._emit_event("slo_breach", scope="replica", replica=index, **doc)
+
+    def _on_replica_slo_clear(self, index: int) -> None:
+        if self._slo_breached.pop(index, None) is not None:
+            self._emit_event("slo_clear", scope="replica", replica=index)
+
+    def _on_fleet_slo_breach(self, breach) -> None:
+        self._fleet_breach = breach.to_doc()
+        self._fleet_breach_count += 1
+        self._emit_event("slo_breach", scope="fleet", **self._fleet_breach)
+
+    def _on_fleet_slo_clear(self) -> None:
+        if self._fleet_breach is not None:
+            self._fleet_breach = None
+            self._emit_event("slo_clear", scope="fleet")
 
     # -- replica lifecycle ----------------------------------------------------
     def _spawn(self, index: int):
         self._health[index] = {"status": "ok"}
         self._rep_done.setdefault(index, 0)
         self._rep_lat.setdefault(index, [])
+        gen = self._spawn_gen.get(index, 0) + 1
+        self._spawn_gen[index] = gen
         if self.cfg.mode == "inprocess":
-            return InProcessReplica(self.cfg.engine_factory(index), index)
+            rep = InProcessReplica(self.cfg.engine_factory(index), index)
+            self._emit_event("spawn", replica=index, gen=gen,
+                             mode="inprocess")
+            return rep
         tdir = None
         if self.cfg.telemetry_base:
             tdir = os.path.join(self.cfg.telemetry_base,
                                 "replica_%d" % index)
-        return ProcessReplica(self.cfg.engine_spec, index,
-                              telemetry_dir=tdir)
+        tfile = None
+        if self._trace:
+            # one fragment file per SPAWN: a respawned replica must not
+            # clobber its predecessor's (possibly never-flushed) fragment
+            tfile = os.path.join(self.cfg.trace_dir,
+                                 "worker_r%d_g%d.json" % (index, gen))
+        spec = dict(self.cfg.engine_spec)
+        spec.update(self.cfg.spec_overrides.get(index, {}))
+        rep = ProcessReplica(spec, index, telemetry_dir=tdir,
+                             trace_file=tfile)
+        if tfile:
+            self._worker_frags.append({
+                "file": os.path.basename(tfile), "replica": index,
+                "gen": gen, "pid": rep.pid,
+                "offset_us": rep.clock_offset_us,
+                "rtt_us": rep.clock_rtt_us})
+        if self._trace:
+            _ftr.on_lifecycle_instant(
+                "spawn replica %d" % index,
+                args={"replica": index, "gen": gen, "pid": rep.pid})
+        self._emit_event("spawn", replica=index, gen=gen, pid=rep.pid,
+                         clock_offset_us=rep.clock_offset_us,
+                         clock_rtt_us=rep.clock_rtt_us)
+        return rep
 
     def _respawn(self, index: int) -> None:
         self._replicas[index] = self._spawn(index)
         _fm.REPLICA_RESTARTS.inc()
+        self._emit_event("restart", replica=index,
+                         gen=self._spawn_gen.get(index))
 
     # -- submission -----------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
@@ -233,12 +366,15 @@ class Router:
                 "fleet queue full (%d)" % self.cfg.max_queue)
         fr = FleetRequest(self._next_id, prompt, max_new_tokens,
                           deadline_s=deadline_s, temperature=temperature,
-                          top_k=top_k, seed=seed)
+                          top_k=top_k, seed=seed,
+                          trace_id="fr%d-%d" % (self._seq, self._next_id))
         self._next_id += 1
         self._requests[fr.id] = fr
         self._queue.append(fr)
         _fm.SUBMITTED.inc()
         _fm.QUEUE_DEPTH.set(len(self._queue))
+        if self._trace:
+            _ftr.on_submitted(fr)
         return fr
 
     # -- accounting -----------------------------------------------------------
@@ -258,6 +394,9 @@ class Router:
         fr.error = error
         fr.finished_t = time.perf_counter()
         _fm.COMPLETED.inc()
+        if self._trace:
+            _ftr.on_terminal(fr)   # also closes a never-dispatched wait
+        fr.queued_since = None
         if fr.last_replica is not None:
             self._rep_done[fr.last_replica] = \
                 self._rep_done.get(fr.last_replica, 0) + 1
@@ -274,7 +413,10 @@ class Router:
                                  % (fr.attempts, why))
             return
         fr.state = "queued"
+        fr.queued_since = time.perf_counter()  # second queued span opens
         self._queue.appendleft(fr)  # retries go to the head: oldest first
+        self._emit_event("requeue", trace_id=fr.trace_id, id=fr.id,
+                         attempts=fr.attempts, why=why)
 
     def _handle_event(self, rep, ev: dict) -> None:
         kind = ev.get("ev")
@@ -291,8 +433,16 @@ class Router:
                                                     "backpressure"):
             # replica-side typed shed: route to a peer, never terminal
             _fm.REROUTED.inc()
+            if self._trace and not fr.terminal:
+                _ftr.on_attempt_end(fr, rep.index, "rerouted")
+            fr.dispatched_t = None
+            self._emit_event("reroute", trace_id=fr.trace_id, id=fr.id,
+                             replica=rep.index, why=ev.get("kind"))
             self._requeue_reroute(fr)
             return
+        if self._trace and not fr.terminal:
+            _ftr.on_attempt_end(fr, rep.index, state)
+        fr.dispatched_t = None
         self._finalize(fr, state, ev.get("tokens"), ev.get("error"))
 
     def _requeue_reroute(self, fr: FleetRequest) -> None:
@@ -301,6 +451,7 @@ class Router:
         if fr.terminal:
             return
         fr.state = "queued"
+        fr.queued_since = time.perf_counter()
         self._queue.appendleft(fr)
 
     # -- the event-loop tick --------------------------------------------------
@@ -316,10 +467,27 @@ class Router:
             if not rep.alive:
                 lost = list(rep.inflight.values())
                 rep.inflight.clear()
+                if lost or rep.accepting:
+                    # accepting distinguishes a detected death from an
+                    # already-accounted drain (accepting was lowered)
+                    self._emit_event("kill_detected", replica=i,
+                                     pid=getattr(rep, "pid", None),
+                                     lost=len(lost))
+                    if self._trace:
+                        _ftr.on_lifecycle_instant(
+                            "replica %d died" % i,
+                            args={"replica": i, "lost": len(lost)})
+                rep.accepting = False
                 for rdoc in lost:
                     fr = self._requests.get(rdoc["id"])
                     if fr is not None and not fr.terminal:
                         _fm.REQUEUED.inc()
+                        if self._trace:
+                            # the worker never reported: close its attempt
+                            # at detection time, tagged killed+synthetic
+                            _ftr.on_attempt_end(fr, i, "killed",
+                                                killed=True)
+                        fr.dispatched_t = None
                         self._requeue(fr, "replica %d died" % i)
                 if self.cfg.auto_restart and not self._draining \
                         and not self._closed:
@@ -329,6 +497,14 @@ class Router:
             for rep in self._replicas:
                 if rep.alive:
                     rep.health()  # answer arrives as a health event
+        if (self._slo is not None or self.cfg.telemetry_base) \
+                and self._ticks % self.cfg.health_every == 0:
+            now = time.monotonic()
+            if now - self._last_obs_t >= 0.5:  # ring reads are file I/O
+                self._last_obs_t = now
+                if self._slo is not None:
+                    self.evaluate_slos()
+                self._write_snapshot()
         self._dispatch()
         _fm.QUEUE_DEPTH.set(len(self._queue))
         _fm.REPLICAS_ALIVE.set(sum(1 for r in self._replicas if r.alive))
@@ -337,6 +513,8 @@ class Router:
     def _replica_healthy(self, rep) -> bool:
         if not rep.alive or not rep.accepting:
             return False
+        if rep.index in self._slo_breached:
+            return False   # SLO breach == degraded: drained, not killed
         if rep.kind == "inprocess":
             h = rep.health()
         else:
@@ -373,6 +551,11 @@ class Router:
             self._queue.popleft()
             fr.state = "dispatched"
             fr.last_replica = rep.index
+            fr.dispatches += 1
+            if self._trace:
+                _ftr.on_dispatch(fr, rep.index)  # closes the queued span
+            fr.queued_since = None
+            fr.dispatched_t = time.perf_counter()
             rep.submit(fr.doc())
             _fm.ROUTED.inc()
 
@@ -397,10 +580,12 @@ class Router:
         others for the whole pass."""
         if timeout_s is None:
             timeout_s = self.cfg.drain_timeout_s
+        t_pass = time.perf_counter()
         summaries = {}
         for i in range(len(self._replicas)):
             rep = self._replicas[i]
             rep.accepting = False
+            t_leg = time.perf_counter()
             if rep.alive:
                 summaries[rep.name] = rep.drain(timeout_s)
             for ev in rep.poll():  # drain's result events (incl. sheds)
@@ -412,10 +597,27 @@ class Router:
                 fr = self._requests.get(rdoc["id"])
                 if fr is not None and not fr.terminal:
                     _fm.REQUEUED.inc()
+                    if self._trace:
+                        _ftr.on_attempt_end(fr, i, "lost_in_drain",
+                                            killed=True)
+                    fr.dispatched_t = None
                     self._requeue(fr, "rolling restart of replica %d" % i)
+            if self._trace:
+                _ftr.on_lifecycle_span(
+                    "drain replica %d" % i, t_leg, time.perf_counter(),
+                    args=dict(summaries.get(rep.name) or {}, replica=i))
+            self._emit_event("drain", replica=i,
+                             summary=summaries.get(rep.name),
+                             lost=len(lost))
             self._respawn(i)
             self.pump()  # rerouted work lands on peers before the next leg
         _fm.ROLLING_RESTARTS.inc()
+        if self._trace:
+            _ftr.on_lifecycle_span("rolling_restart", t_pass,
+                                   time.perf_counter(),
+                                   args={"replicas": len(self._replicas)})
+        self._emit_event("rolling_restart", replicas=len(self._replicas),
+                         duration_s=round(time.perf_counter() - t_pass, 6))
         return summaries
 
     def drain(self, timeout_s: Optional[float] = None) -> dict:
@@ -424,6 +626,7 @@ class Router:
         sheds as terminal REJECTED — typed, counted, never silent)."""
         if timeout_s is None:
             timeout_s = self.cfg.drain_timeout_s
+        t0 = time.perf_counter()
         self._draining = True
         self.wait_all(timeout_s)
         for rep in self._replicas:
@@ -435,10 +638,18 @@ class Router:
         for fr in self._requests.values():
             if not fr.terminal:
                 _fm.REJECTED.inc()
+                if self._trace and fr.dispatched_t is not None:
+                    _ftr.on_attempt_end(fr, fr.last_replica or 0, "shed",
+                                        killed=True)
+                    fr.dispatched_t = None
                 self._finalize(fr, REJECTED, error="router drained")
             out[fr.state] = out.get(fr.state, 0) + 1
         self._queue.clear()
         _fm.QUEUE_DEPTH.set(0)
+        if self._trace:
+            _ftr.on_lifecycle_span("drain", t0, time.perf_counter(),
+                                   args=dict(out))
+        self._emit_event("drain", scope="fleet", summary=out)
         self.close()
         return out
 
@@ -454,6 +665,40 @@ class Router:
             except Exception:
                 pass
         _fm.REPLICAS_ALIVE.set(0)
+        if self._slo is not None:
+            # closing the workers flushed their final telemetry samples;
+            # evaluate them now, while the event log is still open, so a
+            # breach in the last interval is journaled, not lost
+            try:
+                self.evaluate_slos()
+            except Exception:
+                pass
+        self._emit_event("fleet_stop",
+                         requests=len(self._requests),
+                         states=dict(self._request_states()))
+        # workers flushed their fragments on close (atexit); now the
+        # router's own fragment + the merge manifest complete the set
+        self._write_trace()
+        self._write_snapshot()
+        if self._events is not None:
+            self._events.close()
+
+    def _write_trace(self) -> None:
+        if not self._trace:
+            return
+        try:
+            _tr.save_chrome_trace(
+                os.path.join(self.cfg.trace_dir, "router.json"),
+                process_names={os.getpid(): "fleet router"})
+            _ftr.write_manifest(
+                self.cfg.trace_dir,
+                {"file": "router.json", "pid": os.getpid(), "offset_us": 0},
+                self._worker_frags, _runlog.run_id())
+        except OSError:
+            pass
+        if self._own_tracer:
+            _tr.stop_tracing()
+            self._own_tracer = False
 
     def __enter__(self) -> "Router":
         return self
@@ -477,26 +722,43 @@ class Router:
         s = sorted(lat_ms)
         return s[min(len(s) - 1, int(0.99 * len(s)))]
 
-    def snapshot(self) -> dict:
-        """One fleet-wide observability document: router counters,
-        per-replica liveness/health/throughput, and (process mode with a
-        telemetry base) the merged last-sample view of every replica's
-        telemetry ring."""
-        now = time.perf_counter()
-        dt = max(now - self._t0, 1e-9)
+    def _request_states(self) -> Dict[str, int]:
         states: Dict[str, int] = {}
         for fr in self._requests.values():
             states[fr.state] = states.get(fr.state, 0) + 1
+        return states
+
+    def evaluate_slos(self) -> dict:
+        """One fleet-SLO evaluation pass (per-replica + aggregate) over
+        the telemetry base. The pump calls this periodically; drills call
+        it synchronously after workers flushed their final samples."""
+        if self._slo is None or not self.cfg.telemetry_base:
+            return {"replica": {}, "fleet": []}
+        return self._slo.evaluate(self.cfg.telemetry_base,
+                                  [rep.index for rep in self._replicas])
+
+    def snapshot(self) -> dict:
+        """One fleet-wide observability document: router counters,
+        per-replica liveness/health/throughput (with SLO-breach overlay),
+        the active breach set, joinable ids (run_id) and artifact paths
+        (trace dir, event log), and (process mode with a telemetry base)
+        the merged last-sample view of every replica's telemetry ring."""
+        now = time.perf_counter()
+        dt = max(now - self._t0, 1e-9)
         reps = []
         for rep in self._replicas:
             idx = rep.index
             lat = self._rep_lat.get(idx, [])
+            health = (rep.health() if rep.kind == "inprocess" and rep.alive
+                      else self._health.get(idx, {"status": "ok"}))
+            breach = self._slo_breached.get(idx)
+            if breach is not None:
+                health = dict(health, status="degraded", slo_breached=True,
+                              slo=breach.get("slo"))
             reps.append({
                 "name": rep.name, "alive": rep.alive,
                 "accepting": rep.accepting,
-                "health": (rep.health() if rep.kind == "inprocess"
-                           and rep.alive
-                           else self._health.get(idx, {"status": "ok"})),
+                "health": health,
                 "inflight": len(rep.inflight),
                 "completed": self._rep_done.get(idx, 0),
                 "qps": round(self._rep_done.get(idx, 0) / dt, 3),
@@ -504,33 +766,92 @@ class Router:
             })
         out = {"queue_depth": len(self._queue),
                "requests": len(self._requests),
-               "states": states,
+               "states": self._request_states(),
                "replicas": reps,
-               "uptime_s": round(dt, 3)}
+               "uptime_s": round(dt, 3),
+               "run_id": _runlog.run_id()}
+        if self.cfg.trace_dir:
+            out["trace_dir"] = self.cfg.trace_dir
+        if self._events is not None and self._events.armed:
+            out["event_log"] = self._events.path
+        if self._slo is not None:
+            out["slo"] = {
+                "specs": [s.name for s in self.cfg.slos],
+                "breached_replicas": sorted(self._slo_breached),
+                "fleet_breaches": self._fleet_breach_count,
+                "fleet_breach": self._fleet_breach,
+            }
         if self.cfg.telemetry_base:
-            out["telemetry"] = aggregate_telemetry(self.cfg.telemetry_base)
+            out["telemetry"] = aggregate_telemetry(
+                self.cfg.telemetry_base,
+                expected=[rep.index for rep in self._replicas])
         return out
 
+    def _write_snapshot(self) -> None:
+        """Drop ``snapshot.json`` under the telemetry base (atomically) so
+        out-of-process viewers (tools/fleet_top.py --watch) can render the
+        router's live view without a control channel."""
+        base = self.cfg.telemetry_base
+        if not base:
+            return
+        try:
+            os.makedirs(base, exist_ok=True)
+            path = os.path.join(base, "snapshot.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.snapshot(), f, default=repr)
+            os.replace(tmp, path)
+        except OSError:
+            pass
 
-def aggregate_telemetry(base_dir: str) -> dict:
+
+def _replica_index(name: str) -> int:
+    """Numeric index from a ``replica_<i>`` dir name; unparsable names
+    sort last (after replica_9 comes replica_10, not replica_1)."""
+    try:
+        return int(name.split("_", 1)[1])
+    except (IndexError, ValueError):
+        return 1 << 30
+
+
+def aggregate_telemetry(base_dir: str,
+                        expected: Optional[Sequence[int]] = None) -> dict:
     """Merge N replicas' telemetry rings (``<base>/replica_<i>/``, each an
     exporter dir of JSONL ring files) into one fleet view: per replica,
-    the LAST sample of each of its processes. The same files
-    ``tools/dump_metrics --watch dir1,dir2,...`` tails live."""
+    the LAST sample of each of its processes, in NUMERIC replica order.
+    The same files ``tools/dump_metrics --watch dir1,dir2,...`` tails
+    live.
+
+    Degenerate rings never throw — a freshly spawned replica that has not
+    ticked yet, a SIGKILLed one that left only a torn tail, or a ring dir
+    that never appeared (pass ``expected`` indices to detect that) each
+    yield an entry with a ``flag`` explaining the gap, so the aggregate
+    stays healthy and the hole stays visible."""
     from ..monitor import telemetry as _telemetry
 
     out: Dict[str, dict] = {}
     if not base_dir or not os.path.isdir(base_dir):
+        if expected:
+            for idx in expected:
+                out["replica_%d" % idx] = {"samples": 0,
+                                           "flag": "ring dir missing"}
         return out
-    for name in sorted(os.listdir(base_dir)):
+    names = [n for n in os.listdir(base_dir)
+             if n.startswith("replica_")
+             and os.path.isdir(os.path.join(base_dir, n))]
+    for name in sorted(names, key=_replica_index):
         sub = os.path.join(base_dir, name)
-        if not (name.startswith("replica_") and os.path.isdir(sub)):
-            continue
         try:
             series = _telemetry.read_series(sub)
-        except Exception:
+        except Exception as e:
+            out[name] = {"samples": 0, "flag": "unreadable: %s" % e}
             continue
         if series:
-            last = series[-1]
-            out[name] = {"samples": len(series), "last": last}
+            out[name] = {"samples": len(series), "last": series[-1]}
+        else:
+            out[name] = {"samples": 0, "flag": "no complete samples"}
+    for idx in (expected or ()):
+        name = "replica_%d" % idx
+        if name not in out:
+            out[name] = {"samples": 0, "flag": "ring dir missing"}
     return out

@@ -1,0 +1,207 @@
+"""Fleet-level SLO evaluation over aggregated telemetry interval deltas.
+
+One ``ServingEngine`` already evaluates ``PADDLE_TPU_SLO`` specs on its
+own exporter ticks (monitor.slo.SLOMonitor). A fleet needs the same
+grammar evaluated at TWO scopes:
+
+* **per replica** — each replica's ring samples (``<base>/replica_<i>/``)
+  run through their own :class:`~paddle_tpu.monitor.slo.SLOMonitor`, so a
+  breach names the replica and the router can mark exactly it degraded
+  (drained of new traffic, not killed — same policy as an engine-local
+  breach);
+* **fleet aggregate** — every replica's NEW interval deltas since the
+  last evaluation merge into ONE synthetic
+  :class:`~paddle_tpu.monitor.telemetry.TelemetrySample` (counter deltas
+  sum, histogram bucket deltas sum bucket-wise, gauges sum — queue
+  depths add across a fleet) and the same specs run over it, so a p99
+  ceiling is judged against the fleet-wide latency distribution, not any
+  one replica's.
+
+Both scopes reuse the existing spec machinery end to end: breaches tick
+``slo/breaches`` and ``slo/<spec>/breaches``, hit the flight recorder,
+and surface through the monitor callbacks the router wires into
+``Router.snapshot()`` health and the fleet event log.
+
+:class:`FleetSLO` is a pull evaluator — the router calls
+:meth:`evaluate` from its pump (every ``health_every`` ticks) or a drill
+calls it synchronously after ``force_tick``-style flushes; per-(replica,
+pid) seq cursors make each sample evaluate exactly once.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..monitor import telemetry as _telemetry
+from ..monitor.slo import SLO, Breach, SLOMonitor, parse_slos
+
+__all__ = ["FleetSLO", "sample_from_doc", "merge_fleet_docs",
+           "fleet_slos_from_env"]
+
+
+def fleet_slos_from_env() -> List[SLO]:
+    """``PADDLE_TPU_FLEET_SLO`` → specs (same grammar as
+    ``PADDLE_TPU_SLO``; empty/unset/malformed → no specs, never fatal)."""
+    text = os.environ.get("PADDLE_TPU_FLEET_SLO", "").strip()
+    if not text:
+        return []
+    try:
+        return parse_slos(text)
+    except ValueError:
+        import logging
+
+        logging.getLogger("paddle_tpu").warning(
+            "PADDLE_TPU_FLEET_SLO: unparseable spec %r ignored", text)
+        return []
+
+
+def sample_from_doc(doc: dict) -> _telemetry.TelemetrySample:
+    """Rehydrate one ring-file sample doc into a TelemetrySample the SLO
+    specs can evaluate (the doc is ``TelemetrySample.to_doc`` output)."""
+    return _telemetry.TelemetrySample(
+        int(doc.get("seq", 0)), float(doc.get("t", 0.0)),
+        float(doc.get("dt_s", 0.0)), doc.get("metrics") or {},
+        doc.get("deltas") or {"counters": {}, "histograms": {},
+                              "gauges": {}})
+
+
+def merge_fleet_docs(docs: Sequence[dict], seq: int
+                     ) -> Optional[_telemetry.TelemetrySample]:
+    """Merge sample docs from N replicas into one fleet-aggregate sample.
+
+    Deltas: counters and histogram (count/sum/bucket) deltas sum — the
+    union of every replica's interval observations. Gauges sum across
+    replicas (fleet queue depth = sum of per-replica depths) in both the
+    delta map and the merged snapshot. Histogram SNAPSHOTS merge
+    bucket-wise so the full bound grid survives for interval-percentile
+    interpolation. ``dt_s`` is the widest contributing window (replica
+    windows overlap in wall time; summing them would understate rates).
+    """
+    if not docs:
+        return None
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    hists: Dict[str, dict] = {}
+    metrics: Dict[str, dict] = {}
+    t = 0.0
+    dt = 0.0
+    for doc in docs:
+        t = max(t, float(doc.get("t", 0.0)))
+        dt = max(dt, float(doc.get("dt_s", 0.0)))
+        d = doc.get("deltas") or {}
+        for n, v in (d.get("counters") or {}).items():
+            counters[n] = counters.get(n, 0.0) + v
+        for n, v in (d.get("gauges") or {}).items():
+            gauges[n] = gauges.get(n, 0.0) + v
+        for n, h in (d.get("histograms") or {}).items():
+            agg = hists.setdefault(n, {"count": 0, "sum": 0.0, "buckets": {}})
+            agg["count"] += h.get("count", 0)
+            agg["sum"] += h.get("sum", 0.0)
+            for k, c in (h.get("buckets") or {}).items():
+                agg["buckets"][k] = agg["buckets"].get(k, 0) + c
+        for n, snap in (doc.get("metrics") or {}).items():
+            cur = metrics.get(n)
+            if cur is None:
+                cur = dict(snap)
+                if isinstance(cur.get("buckets"), dict):
+                    cur["buckets"] = dict(cur["buckets"])
+                metrics[n] = cur
+                continue
+            kind = snap.get("type")
+            if kind in ("counter", "gauge"):
+                cur["value"] = (float(cur.get("value", 0.0))
+                                + float(snap.get("value", 0.0)))
+            elif kind == "histogram":
+                cur["count"] = cur.get("count", 0) + snap.get("count", 0)
+                cur["sum"] = cur.get("sum", 0.0) + snap.get("sum", 0.0)
+                b = cur.setdefault("buckets", {})
+                for k, c in (snap.get("buckets") or {}).items():
+                    b[k] = b.get(k, 0) + c
+    return _telemetry.TelemetrySample(
+        seq, t, dt, metrics,
+        {"counters": counters, "histograms": hists, "gauges": gauges})
+
+
+class FleetSLO:
+    """Per-replica + fleet-aggregate SLO evaluation over the telemetry
+    base dir. Callbacks: ``on_replica_breach(index, breach)`` /
+    ``on_replica_clear(index)`` for replica-scoped outcomes and
+    ``on_fleet_breach(breach)`` / ``on_fleet_clear()`` for the aggregate
+    — the router maps these onto snapshot health and the event log."""
+
+    def __init__(self, specs: Sequence[SLO],
+                 on_replica_breach: Optional[Callable[[int, Breach],
+                                                      None]] = None,
+                 on_replica_clear: Optional[Callable[[int], None]] = None,
+                 on_fleet_breach: Optional[Callable[[Breach], None]] = None,
+                 on_fleet_clear: Optional[Callable[[], None]] = None):
+        self.specs = list(specs)
+        self._on_rep_breach = on_replica_breach
+        self._on_rep_clear = on_replica_clear
+        self._cursors: Dict[Tuple[int, int], int] = {}  # (replica,pid)->seq
+        self._agg_seq = 0
+        self._rep_monitors: Dict[int, SLOMonitor] = {}
+        self._fleet_monitor = SLOMonitor(
+            self.specs, on_breach=on_fleet_breach, on_clear=on_fleet_clear)
+
+    def _monitor(self, index: int) -> SLOMonitor:
+        mon = self._rep_monitors.get(index)
+        if mon is None:
+            def _breach(b, i=index):
+                if self._on_rep_breach is not None:
+                    self._on_rep_breach(i, b)
+
+            def _clear(i=index):
+                if self._on_rep_clear is not None:
+                    self._on_rep_clear(i)
+
+            mon = SLOMonitor(self.specs, on_breach=_breach, on_clear=_clear)
+            self._rep_monitors[index] = mon
+        return mon
+
+    def _new_docs(self, base_dir: str, index: int) -> List[dict]:
+        sub = os.path.join(base_dir, "replica_%d" % index)
+        if not os.path.isdir(sub):
+            return []
+        try:
+            series = _telemetry.read_series(sub)
+        except Exception:
+            return []
+        fresh = []
+        for doc in series:
+            key = (index, int(doc.get("pid", 0)))
+            if int(doc.get("seq", 0)) > self._cursors.get(key, 0):
+                fresh.append(doc)
+                self._cursors[key] = int(doc.get("seq", 0))
+        return fresh
+
+    def evaluate(self, base_dir: str, replica_indices: Sequence[int]
+                 ) -> dict:
+        """One evaluation pass over every replica's unseen samples plus
+        one merged fleet-aggregate sample; returns
+        ``{"replica": {index: [breach docs]}, "fleet": [breach docs]}``
+        for the breaches of THIS pass."""
+        out: Dict[str, object] = {"replica": {}, "fleet": []}
+        if not self.specs or not base_dir:
+            return out
+        all_new: List[dict] = []
+        for idx in replica_indices:
+            docs = self._new_docs(base_dir, idx)
+            if not docs:
+                continue
+            all_new.extend(docs)
+            mon = self._monitor(idx)
+            breaches: List[Breach] = []
+            for doc in docs:
+                breaches.extend(mon.on_sample(sample_from_doc(doc)))
+            if breaches:
+                out["replica"][idx] = [b.to_doc() for b in breaches]
+        if all_new:
+            self._agg_seq += 1
+            merged = merge_fleet_docs(all_new, self._agg_seq)
+            if merged is not None:
+                out["fleet"] = [
+                    b.to_doc()
+                    for b in self._fleet_monitor.on_sample(merged)]
+        return out

@@ -1,0 +1,395 @@
+"""Fleet-side request tracing: router tracks, fragment merge, validation.
+
+The cross-process half of the serving trace story (serving/trace.py).
+When a router is armed with ``FleetConfig.trace_dir`` it starts the host
+tracer in its own process and emits ROUTER-side spans for every fleet
+request, on virtual tracks:
+
+* ``fleet queue`` — ``submitted`` instants, one ``queued`` span per wait
+  (submission → dispatch, and requeue → re-dispatch for replays), and
+  exactly one terminal instant (``finished``/``failed``/``timeout``/
+  ``rejected``) per request;
+* ``replica <i>`` — a ``dispatch`` instant plus an ``attempt <n>`` span
+  per dispatch (dispatch → result received). A replica death closes the
+  open attempt synthetically at detection time, tagged ``killed`` +
+  ``synthetic_close`` — the requeued replay then opens ``attempt <n+1>``
+  under the SAME ``trace_id``;
+* ``fleet lifecycle`` — ``drain replica <i>``, ``rolling_restart`` and
+  ``drain`` windows, spawn/death instants.
+
+Workers are armed per-replica (``PADDLE_TPU_TRACE_FILE`` injected by the
+router, one fragment file per spawn generation) and additionally emit a
+``serve`` span per request on their ``worker engine`` track; a real
+engine's serving-cat spans (queued/prefill/decode/lifetime) carry the
+FLEET trace id + attempt because the router propagates both through the
+submit frames. A SIGKILLed worker writes no fragment — its side of the
+timeline is exactly the hole the router's synthetic closure documents.
+
+Per-worker clocks are aligned by the handshake offset the router
+measured at spawn (see ``ProcessReplica._clock_sync``): the router
+writes ``fleet_manifest.json`` into the trace dir mapping every fragment
+to its pid/replica/generation/offset, and :func:`load_fragments` applies
+the offsets so all fragments land on the ROUTER's span clock.
+:func:`validate_fleet_spans` is the fleet-level analogue of
+``serving.trace.validate_request_spans``: every traced request joins
+into one well-nested cross-process tree with exactly one terminal, and
+orphaned spans (a dispatch whose attempt never closed, a request with no
+terminal — a crashed router's leftovers) are closed synthetically and
+tagged before the invariants run. ``tools/fleet_trace.py`` is the CLI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..monitor import tracer as _tr
+from ..serving import trace as _sv
+
+__all__ = [
+    "CAT", "QUEUE_TRACK", "LIFECYCLE_TRACK", "WORKER_TRACK", "MANIFEST",
+    "MANIFEST_SCHEMA", "replica_track",
+    "on_submitted", "on_dispatch", "on_attempt_end", "on_terminal",
+    "on_lifecycle_span", "on_lifecycle_instant", "on_worker_serve",
+    "write_manifest", "load_fragments", "process_names",
+    "fleet_request_spans", "close_orphans", "validate_fleet_spans",
+]
+
+CAT = "fleet"
+QUEUE_TRACK = "fleet queue"
+LIFECYCLE_TRACK = "fleet lifecycle"
+WORKER_TRACK = "worker engine"
+MANIFEST = "fleet_manifest.json"
+MANIFEST_SCHEMA = "paddle_tpu.fleet_trace/v1"
+
+_TERMINALS = ("finished", "failed", "timeout", "rejected")
+
+
+def replica_track(index: int) -> str:
+    return "replica %d" % index
+
+
+def _us(t_s: float) -> int:
+    return int(t_s * 1e6)
+
+
+# -- router-side emission (callers guard on Router._trace; these guard on
+# tracer.active() so a stray call without the tracer is one bool read) -------
+
+def on_submitted(fr) -> None:
+    if not _tr.active():
+        return
+    _tr.record_instant(
+        "submitted", _us(fr.submitted_t), cat=CAT, track=QUEUE_TRACK,
+        args={"trace_id": fr.trace_id, "prompt_len": len(fr.prompt),
+              "max_new_tokens": fr.max_new_tokens})
+
+
+def on_dispatch(fr, replica_index: int) -> None:
+    """Close the open queue-wait span and mark the dispatch on the
+    replica's track. ``fr.dispatches`` must already count this dispatch
+    (it is the 1-based attempt number)."""
+    if not _tr.active():
+        return
+    now = time.perf_counter()
+    if fr.queued_since is not None:
+        _tr.record_span(
+            "queued", _us(fr.queued_since), _us(now) - _us(fr.queued_since),
+            cat=CAT, track=QUEUE_TRACK,
+            args={"trace_id": fr.trace_id, "attempt": fr.dispatches,
+                  "replica": replica_index})
+    _tr.record_instant(
+        "dispatch", _us(now), cat=CAT, track=replica_track(replica_index),
+        args={"trace_id": fr.trace_id, "attempt": fr.dispatches})
+
+
+def on_attempt_end(fr, replica_index: int, outcome: str,
+                   killed: bool = False) -> None:
+    """The attempt window: dispatch → result received, or dispatch →
+    death detected (then ``killed`` tags the synthetic close — the worker
+    never reported, the router is closing the orphan)."""
+    if not _tr.active() or fr.dispatched_t is None:
+        return
+    args = {"trace_id": fr.trace_id, "attempt": fr.dispatches,
+            "outcome": outcome}
+    if killed:
+        args["killed"] = True
+        args["synthetic_close"] = True
+    _tr.record_span(
+        "attempt %d" % fr.dispatches, _us(fr.dispatched_t),
+        max(1, _us(time.perf_counter()) - _us(fr.dispatched_t)),
+        cat=CAT, track=replica_track(replica_index), args=args)
+
+
+def on_terminal(fr) -> None:
+    """Exactly-once terminal instant on the queue track (plus the close
+    of a queue wait that never reached a dispatch — a drain shedding
+    queued work)."""
+    if not _tr.active():
+        return
+    end = fr.finished_t if fr.finished_t is not None else time.perf_counter()
+    if fr.queued_since is not None:
+        _tr.record_span(
+            "queued", _us(fr.queued_since), _us(end) - _us(fr.queued_since),
+            cat=CAT, track=QUEUE_TRACK,
+            args={"trace_id": fr.trace_id, "attempt": None})
+    _tr.record_instant(
+        fr.state, _us(end), cat=CAT, track=QUEUE_TRACK,
+        args={"trace_id": fr.trace_id, "state": fr.state,
+              "attempts": fr.dispatches})
+
+
+def on_lifecycle_span(name: str, t0_s: float, t1_s: float,
+                      args: Optional[dict] = None) -> None:
+    if not _tr.active():
+        return
+    _tr.record_span(name, _us(t0_s), max(1, _us(t1_s) - _us(t0_s)), cat=CAT,
+                    track=LIFECYCLE_TRACK, args=args)
+
+
+def on_lifecycle_instant(name: str, args: Optional[dict] = None) -> None:
+    if not _tr.active():
+        return
+    _tr.record_instant(name, _us(time.perf_counter()), cat=CAT,
+                       track=LIFECYCLE_TRACK, args=args)
+
+
+def on_worker_serve(trace_id: Optional[str], attempt: int, state: str,
+                    t0_s: float, t1_s: float) -> None:
+    """Worker-side: one ``serve`` span per request, frame-received →
+    result-sent, on the worker's own track. Emitted for sim AND real
+    engines, so the cross-process join exists even when the engine has no
+    serving-cat tracing of its own."""
+    if not _tr.active() or not trace_id:
+        return
+    _tr.record_span(
+        "serve", _us(t0_s), max(1, _us(t1_s) - _us(t0_s)), cat=CAT,
+        track=WORKER_TRACK,
+        args={"trace_id": trace_id, "attempt": attempt, "state": state})
+
+
+# -- manifest + merge ---------------------------------------------------------
+
+def write_manifest(trace_dir: str, router_entry: dict,
+                   worker_entries: Sequence[dict], run_id: str) -> str:
+    """``fleet_manifest.json``: the merge recipe — which fragment file is
+    whose, and each worker's measured clock offset (µs; subtracting it
+    moves that worker's timestamps onto the router's clock)."""
+    doc = {"schema": MANIFEST_SCHEMA, "run_id": run_id,
+           "router": dict(router_entry), "workers": list(worker_entries)}
+    path = os.path.join(trace_dir, MANIFEST)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def process_names(manifest: dict) -> Dict[int, str]:
+    names: Dict[int, str] = {}
+    router = manifest.get("router") or {}
+    if router.get("pid") is not None:
+        names[router["pid"]] = "fleet router"
+    for e in manifest.get("workers") or []:
+        if e.get("pid") is not None:
+            names[e["pid"]] = ("fleet worker replica %s (gen %s)"
+                               % (e.get("replica", "?"), e.get("gen", 0)))
+    return names
+
+
+def load_fragments(trace_dir: str
+                   ) -> Tuple[List[dict], dict, List[dict]]:
+    """Load every fragment the manifest names, apply per-worker clock
+    offsets, and return (spans, manifest, problems). A missing or
+    unreadable fragment (a SIGKILLed worker never flushes one) is a
+    PROBLEM entry, never an exception — the merged timeline of the
+    survivors is exactly the post-mortem artifact wanted."""
+    with open(os.path.join(trace_dir, MANIFEST)) as f:
+        manifest = json.load(f)
+    spans: List[dict] = []
+    problems: List[dict] = []
+    entries = []
+    router = manifest.get("router") or {}
+    if router.get("file"):
+        entries.append(dict(router, replica=None))
+    entries.extend(manifest.get("workers") or [])
+    for e in entries:
+        fname = e.get("file")
+        if not fname:
+            continue
+        path = os.path.join(trace_dir, fname)
+        if not os.path.exists(path):
+            problems.append({"file": fname, "replica": e.get("replica"),
+                             "gen": e.get("gen"), "problem": "missing"})
+            continue
+        try:
+            frag = _tr.load_spans(path)
+        except Exception as ex:
+            problems.append({"file": fname, "replica": e.get("replica"),
+                             "gen": e.get("gen"),
+                             "problem": "unreadable: %s" % ex})
+            continue
+        off = int(e.get("offset_us", 0) or 0)
+        for s in frag:
+            if off:
+                s = dict(s, ts_us=int(s.get("ts_us", 0)) - off)
+            spans.append(s)
+    return spans, manifest, problems
+
+
+# -- read-back / validation ---------------------------------------------------
+
+def fleet_request_spans(spans: Sequence[dict]) -> Dict[str, List[dict]]:
+    """Group spans of EVERY category by ``args.trace_id``, keeping only
+    trace ids rooted by a fleet ``submitted`` instant (the router's view
+    defines the request set; engine-local ``req-*`` ids without a fleet
+    root are not fleet requests)."""
+    by_id: Dict[str, List[dict]] = {}
+    roots = set()
+    for s in spans:
+        tid = (s.get("args") or {}).get("trace_id")
+        if not tid:
+            continue
+        by_id.setdefault(tid, []).append(s)
+        if s.get("cat") == CAT and s.get("name") == "submitted":
+            roots.add(tid)
+    return {tid: v for tid, v in by_id.items() if tid in roots}
+
+
+def _attempt_no(s: dict) -> Optional[int]:
+    a = (s.get("args") or {}).get("attempt")
+    return int(a) if a is not None else None
+
+
+def close_orphans(spans: Sequence[dict]) -> Tuple[List[dict], int]:
+    """Synthesize closure for what a death left open: a ``dispatch``
+    instant whose attempt span never closed becomes a synthetic attempt
+    span (tagged ``synthetic``/``killed``) running to the end of the
+    trace, and a submitted request with no terminal instant gets a
+    synthetic ``failed`` terminal. Returns (spans + synthesized, count).
+    A cleanly drained router produces zero orphans — the router itself
+    closes killed attempts at death-detection time."""
+    spans = list(spans)
+    t_max = max((int(s.get("ts_us", 0)) + int(s.get("dur_us", 0))
+                 for s in spans), default=0)
+    synth: List[dict] = []
+    for tid, mine in fleet_request_spans(spans).items():
+        fleet_mine = [s for s in mine if s.get("cat") == CAT]
+        closed = {_attempt_no(s) for s in fleet_mine
+                  if s.get("name", "").startswith("attempt")
+                  and s.get("dur_us")}
+        for s in fleet_mine:
+            if s.get("name") != "dispatch" or s.get("dur_us"):
+                continue
+            a = _attempt_no(s)
+            if a in closed:
+                continue
+            synth.append({
+                "name": "attempt %s" % a, "cat": CAT,
+                "ts_us": int(s["ts_us"]),
+                "dur_us": max(1, t_max - int(s["ts_us"])),
+                "pid": s.get("pid", 0), "tid": s.get("tid", 0),
+                **({"track": s["track"]} if s.get("track") else {}),
+                "args": {"trace_id": tid, "attempt": a, "outcome": "lost",
+                         "killed": True, "synthetic": True}})
+        if not any(s.get("name") in _TERMINALS and not s.get("dur_us")
+                   for s in fleet_mine):
+            anchor = fleet_mine[0]
+            synth.append({
+                "name": "failed", "cat": CAT, "ts_us": t_max, "dur_us": 0,
+                "pid": anchor.get("pid", 0),
+                "tid": anchor.get("tid", 0), "track": QUEUE_TRACK,
+                "args": {"trace_id": tid, "state": "failed",
+                         "synthetic": True}})
+    return spans + synth, len(synth)
+
+
+def validate_fleet_spans(spans: Sequence[dict], slack_us: int = 20000
+                         ) -> Dict[str, dict]:
+    """The fleet-level analogue of ``serving.trace.validate_request_spans``
+    over a MERGED multi-process span set (offsets already applied).
+
+    Per fleet request: a ``submitted`` instant, >= 1 ``queued`` span,
+    exactly ONE terminal instant; attempt spans with strictly increasing
+    attempt numbers and non-overlapping windows in order; every
+    worker-side span of the request (the worker ``serve`` span, a real
+    engine's serving-cat spans) contained in its attempt's window within
+    ``slack_us`` (the clock-offset correction error bound — an unaligned
+    merge fails HERE). Orphans are closed synthetically first (tagged, so
+    the digest reports them). Per-process serving-cat tracks must be
+    well-nested (the shared serving validator core). Returns
+    {trace_id: digest}."""
+    spans, n_synth = close_orphans(spans)
+    digests: Dict[str, dict] = {}
+    for tid, mine in fleet_request_spans(spans).items():
+        fleet_mine = [s for s in mine if s.get("cat") == CAT]
+        router_pid = next(s.get("pid") for s in fleet_mine
+                          if s.get("name") == "submitted")
+        names = [s.get("name") for s in fleet_mine]
+        assert "queued" in names, \
+            "request %s: no queued span (names: %s)" % (tid, names)
+        terminals = [s for s in fleet_mine
+                     if s.get("name") in _TERMINALS and not s.get("dur_us")]
+        assert len(terminals) == 1, \
+            "request %s: %d terminal instants (want exactly 1)" \
+            % (tid, len(terminals))
+        attempts = sorted(
+            (s for s in fleet_mine
+             if s.get("name", "").startswith("attempt") and s.get("dur_us")),
+            key=lambda s: _attempt_no(s) or 0)
+        nums = [_attempt_no(s) for s in attempts]
+        assert nums == sorted(set(nums)), \
+            "request %s: attempt numbers not strictly increasing: %s" \
+            % (tid, nums)
+        windows: Dict[int, Tuple[int, int]] = {}
+        prev_hi = None
+        for s in attempts:
+            lo = int(s["ts_us"])
+            hi = lo + int(s["dur_us"])
+            if prev_hi is not None:
+                assert lo >= prev_hi - slack_us, (
+                    "request %s: attempt %s [%d,%d] overlaps the previous "
+                    "attempt (ended %d)" % (tid, _attempt_no(s), lo, hi,
+                                            prev_hi))
+            prev_hi = hi
+            windows[_attempt_no(s)] = (lo, hi)
+        union = list(windows.values())
+        worker_spans = 0
+        for s in mine:
+            if s.get("pid") == router_pid and s.get("cat") == CAT:
+                continue
+            worker_spans += 1
+            lo = int(s.get("ts_us", 0))
+            hi = lo + int(s.get("dur_us", 0))
+            a = _attempt_no(s)
+            cands = [windows[a]] if a in windows else union
+            assert any(w[0] - slack_us <= lo and hi <= w[1] + slack_us
+                       for w in cands), (
+                "request %s: worker span %r [%d,%d] escapes its attempt "
+                "window(s) %s (+/-%dus) — clock offsets misapplied?"
+                % (tid, s.get("name"), lo, hi, cands, slack_us))
+        outcomes = {n: (s.get("args") or {}).get("outcome")
+                    for n, s in zip(nums, attempts)}
+        digests[tid] = {
+            "state": terminals[0].get("name"),
+            "attempts": nums,
+            "outcomes": outcomes,
+            "killed": [n for n, s in zip(nums, attempts)
+                       if (s.get("args") or {}).get("killed")],
+            "worker_spans": worker_spans,
+            "synthetic": any((s.get("args") or {}).get("synthetic")
+                             for s in fleet_mine),
+        }
+    # worker engine internals: each process's serving tracks well-nested
+    _sv.assert_well_nested(spans, cat=_sv.CAT)
+    # lifecycle windows (drain-within-rolling-restart) nest too
+    life = [s for s in spans
+            if s.get("cat") == CAT
+            and (s.get("name") == "rolling_restart"
+                 or str(s.get("name", "")).startswith("drain"))]
+    _sv.assert_well_nested(life, cat=CAT, exempt=())
+    digests["_meta"] = {"synthetic_closures": n_synth,
+                        "requests": len(digests)}
+    return digests

@@ -11,15 +11,27 @@ ops (router -> worker)::
      "max_new_tokens": n, "temperature": t, "top_k": k, "seed": s,
      "deadline_s": d}
     {"op": "health"}                         # answered by a health event
+    {"op": "clock"}                          # answered by a clock event
     {"op": "drain", "timeout_s": t}          # graceful stop, then exit
     {"op": "shutdown"}                       # immediate close, then exit
 
 events (worker -> router)::
 
     {"ev": "ready", "pid": ...}              # spec accepted, engine warm
+    {"ev": "clock", "t_us": ...}             # tracer.now_us() snapshot
     {"ev": "result", "id", "state", "tokens", "error"[, "kind"]}
     {"ev": "health", "health": {...}}
     {"ev": "drained", "summary": {...}}      # last frame before exit
+
+Tracing: submits carry the fleet ``trace_id`` + ``attempt``, threaded
+into the engine's Request so a real engine's serving spans join the
+cross-process tree; the worker additionally emits one fleet-cat
+``serve`` span per request (frame received → result sent) so sim-engine
+workers are joinable too. The fragment file itself is the ordinary
+``PADDLE_TPU_TRACE_FILE`` autostart (armed per-replica by the router);
+the ``clock`` op is the router's offset handshake. An optional spec key
+``"fault_plan"`` installs a ``reliability.faults`` plan process-wide —
+per-replica chaos (e.g. a latency fault degrading one replica's tail).
 
 The spec is the ISSUE's "engine handle extraction": the serving engine's
 construction knobs, serialized. ``{"engine": "real", "model": {DecoderConfig
@@ -47,8 +59,10 @@ import sys
 import time
 from typing import Dict, Optional
 
+from ..monitor import tracer as _tracer
 from ..serving.request import (FAILED, REJECTED, BackpressureError,
                                DrainingError, Request)
+from . import trace as _ftrace
 from .protocol import FrameReader, send_frame
 
 __all__ = ["main"]
@@ -76,6 +90,9 @@ class _Worker:
         self.engine = engine
         self._by_req: Dict[int, int] = {}      # engine Request.id -> fleet id
         self._requests: Dict[int, Request] = {}
+        # engine Request.id -> (trace_id, attempt, frame-received time);
+        # feeds the per-request ``serve`` span on the worker's own track
+        self._meta: Dict[int, tuple] = {}
 
     def emit(self, ev: dict) -> None:
         send_frame(self.chan, ev)
@@ -83,18 +100,25 @@ class _Worker:
     def _result(self, req: Request) -> None:
         fid = self._by_req.pop(req.id, None)
         self._requests.pop(req.id, None)
+        meta = self._meta.pop(req.id, None)
         if fid is None:
             return
+        if meta is not None:
+            _ftrace.on_worker_serve(meta[0], meta[1], req.state, meta[2],
+                                    time.perf_counter())
         self.emit({"ev": "result", "id": fid, "state": req.state,
                    "tokens": list(req.tokens_out), "error": req.error})
 
     def submit(self, op: dict) -> None:
+        t_recv = time.perf_counter()
         try:
             req = self.engine.submit(
                 op["prompt"], op["max_new_tokens"],
                 deadline_s=op.get("deadline_s"),
                 temperature=op.get("temperature", 0.0),
-                top_k=op.get("top_k", 0), seed=op.get("seed"))
+                top_k=op.get("top_k", 0), seed=op.get("seed"),
+                trace_id=op.get("trace_id"),
+                attempt=int(op.get("attempt", 0)))
         except DrainingError:
             self.emit({"ev": "result", "id": op["id"], "state": REJECTED,
                        "kind": "draining"})
@@ -109,6 +133,8 @@ class _Worker:
             return
         self._by_req[req.id] = op["id"]
         self._requests[req.id] = req
+        self._meta[req.id] = (op.get("trace_id"), int(op.get("attempt", 0)),
+                              t_recv)
 
     def pump(self) -> None:
         for req in self.engine.step():
@@ -124,9 +150,13 @@ class _Worker:
         for rid in list(self._by_req):
             req = self._requests.pop(rid, None)
             fid = self._by_req.pop(rid)
+            meta = self._meta.pop(rid, None)
             if req is None:
                 continue
             state = req.state if req.state != "running" else "timeout"
+            if meta is not None:
+                _ftrace.on_worker_serve(meta[0], meta[1], state, meta[2],
+                                        time.perf_counter())
             ev = {"ev": "result", "id": fid, "state": state,
                   "tokens": list(req.tokens_out), "error": req.error}
             if state == REJECTED:
@@ -166,6 +196,14 @@ def main() -> int:
     # and release() flushes a final partial sample even for short lives
     from ..monitor import telemetry as _telemetry
 
+    if spec.get("fault_plan"):
+        # per-replica chaos: the router passes a plan for THIS replica
+        # only (FleetConfig.spec_overrides), e.g. a latency fault that
+        # degrades one replica's tail for the fleet-SLO drill
+        from ..reliability import faults as _faults
+
+        _faults.install(_faults.FaultPlan.parse(str(spec["fault_plan"])))
+
     tele = _telemetry.acquire()
     try:
         worker = _Worker(chan, _build_engine(spec))
@@ -181,6 +219,11 @@ def main() -> int:
                 elif kind == "health":
                     worker.emit({"ev": "health",
                                  "health": worker.engine.health()})
+                elif kind == "clock":
+                    # offset handshake: one span-clock sample, answered
+                    # immediately (the router brackets it with its own
+                    # now_us() reads and takes the midpoint)
+                    worker.emit({"ev": "clock", "t_us": _tracer.now_us()})
                 elif kind == "drain":
                     worker.drain(op.get("timeout_s"))
                     return 0

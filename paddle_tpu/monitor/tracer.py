@@ -37,10 +37,23 @@ __all__ = [
     "span", "start_tracing", "stop_tracing", "active", "get_spans",
     "clear_spans", "save_spans", "load_spans", "to_chrome_trace",
     "save_chrome_trace", "SPAN_SCHEMA",
-    "virtual_track", "record_span", "record_instant",
+    "virtual_track", "record_span", "record_instant", "now_us",
 ]
 
 SPAN_SCHEMA = "paddle_tpu.host_spans/v1"
+
+# Test-only clock skew (µs), read once at import: every timestamp this
+# process records OR reports (now_us(), span()/instant(), record_span's
+# explicit ts) is shifted by it — the process behaves as if its
+# perf_counter epoch differed. The fleet clock-offset handshake
+# (fleet.replica.ProcessReplica) measures exactly this shift, and
+# tools/fleet_trace.py's selftest injects a known skew into its workers
+# to assert the midpoint estimate recovers it. Never set in production.
+try:
+    _skew_us: int = int(
+        os.environ.get("PADDLE_TPU_TRACE_CLOCK_SKEW_US", "0") or 0)
+except ValueError:
+    _skew_us = 0
 
 _active: bool = False
 _spans: List[Dict[str, Any]] = []
@@ -67,6 +80,13 @@ _dropped: int = 0
 
 def active() -> bool:
     return _active
+
+
+def now_us() -> int:
+    """This process's span clock, µs: ``perf_counter`` plus the injected
+    test skew — the value cross-process clock handshakes must report so
+    the handshake measures the same clock the spans are stamped with."""
+    return time.perf_counter_ns() // 1000 + _skew_us
 
 
 def start_tracing() -> None:
@@ -161,14 +181,15 @@ def span(name: str, cat: str = "host", args: Optional[dict] = None,
         if ann is not None:
             ann.__exit__(None, None, None)
         if _active:
-            _record(name, cat, t0 // 1000, max(1, dur // 1000), args, depth)
+            _record(name, cat, t0 // 1000 + _skew_us, max(1, dur // 1000),
+                    args, depth)
 
 
 def instant(name: str, cat: str = "host", args: Optional[dict] = None) -> None:
     """Zero-duration marker (rendered as an instant event)."""
     if not _active:
         return
-    _record(name, cat, time.perf_counter_ns() // 1000, 0, args)
+    _record(name, cat, now_us(), 0, args)
 
 
 __all__.append("instant")
@@ -203,7 +224,7 @@ def record_span(name: str, ts_us: int, dur_us: int, cat: str = "host",
     rec = {
         "name": name,
         "cat": cat,
-        "ts_us": int(ts_us),
+        "ts_us": int(ts_us) + _skew_us,
         "dur_us": max(0, int(dur_us)),
         "pid": os.getpid(),
         "tid": tid if tid is not None else threading.get_ident(),
@@ -274,9 +295,13 @@ def load_spans(path: str) -> List[dict]:
     raise ValueError("%s: not a %s or Chrome-trace file" % (path, SPAN_SCHEMA))
 
 
-def to_chrome_trace(spans: Optional[List[dict]] = None) -> dict:
+def to_chrome_trace(spans: Optional[List[dict]] = None,
+                    process_names: Optional[Dict[int, str]] = None) -> dict:
     """Spans → ``chrome://tracing`` JSON object (the ``tools/timeline.py``
-    output format: ``traceEvents`` complete events + metadata)."""
+    output format: ``traceEvents`` complete events + metadata).
+    ``process_names`` labels pids individually (a merged multi-process
+    fleet timeline names its router/worker rows); unlisted pids keep the
+    default label."""
     spans = spans if spans is not None else get_spans()
     events: List[dict] = []
     seen_threads = set()
@@ -306,15 +331,17 @@ def to_chrome_trace(spans: Optional[List[dict]] = None) -> dict:
             ev["args"] = s["args"]
         events.append(ev)
     for pid in {s.get("pid", 0) for s in spans}:
+        label = (process_names or {}).get(pid, "paddle_tpu host")
         events.append({"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
-                       "args": {"name": "paddle_tpu host"}})
+                       "args": {"name": label}})
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "otherData": {"producer": "paddle_tpu.monitor.tracer"}}
 
 
-def save_chrome_trace(path: str, spans: Optional[List[dict]] = None) -> str:
+def save_chrome_trace(path: str, spans: Optional[List[dict]] = None,
+                      process_names: Optional[Dict[int, str]] = None) -> str:
     with open(path, "w") as f:
-        json.dump(to_chrome_trace(spans), f)
+        json.dump(to_chrome_trace(spans, process_names=process_names), f)
     return path
 
 

@@ -360,7 +360,8 @@ class ServingEngine:
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                deadline_s: Optional[float] = None,
                temperature: float = 0.0, top_k: int = 0,
-               seed: Optional[int] = None) -> Request:
+               seed: Optional[int] = None,
+               trace_id: Optional[str] = None, attempt: int = 0) -> Request:
         """Queue a request. Raises ``ValueError`` for a request that can
         NEVER be served at this geometry, and ``BackpressureError`` when
         the bounded queue is full (shed/retry — transient). ``deadline_s``
@@ -375,7 +376,8 @@ class ServingEngine:
                 "engine is draining (graceful shutdown): not admitting new "
                 "requests — re-route to a peer")
         req = Request(prompt, max_new_tokens, deadline_s=deadline_s,
-                      temperature=temperature, top_k=top_k, seed=seed)
+                      temperature=temperature, top_k=top_k, seed=seed,
+                      trace_id=trace_id, attempt=attempt)
         if req.prompt_len > self.cfg.prompt_buckets[-1]:
             raise ValueError(
                 "prompt length %d exceeds the largest prefill bucket %d"

@@ -65,13 +65,14 @@ class Request:
 
     __slots__ = ("id", "prompt", "max_new_tokens", "state", "slot", "pages",
                  "tokens_out", "submitted_t", "admitted_t", "first_token_t",
-                 "finished_t", "deadline_s", "error", "trace_id",
+                 "finished_t", "deadline_s", "error", "trace_id", "attempt",
                  "temperature", "top_k", "seed")
 
     def __init__(self, prompt: Sequence[int], max_new_tokens: int,
                  deadline_s: Optional[float] = None,
                  temperature: float = 0.0, top_k: int = 0,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None,
+                 trace_id: Optional[str] = None, attempt: int = 0):
         if len(prompt) == 0:
             raise ValueError("Request needs a non-empty prompt")
         if max_new_tokens < 1:
@@ -85,8 +86,12 @@ class Request:
         self.id = next(_ids)
         # The per-request trace identity: spans in the serving timeline and
         # flight-recorder batch specs carry it, so a crash dump links back
-        # to the exact request lifelines in the Perfetto trace.
-        self.trace_id = "req-%d" % self.id
+        # to the exact request lifelines in the Perfetto trace. A fleet
+        # router overrides it with the FLEET trace id (stable across
+        # requeues) so one cross-process timeline joins every attempt;
+        # ``attempt`` (1-based, 0 = not a fleet replay) rides span args.
+        self.trace_id = trace_id if trace_id else "req-%d" % self.id
+        self.attempt = int(attempt)
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.state = QUEUED

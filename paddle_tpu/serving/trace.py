@@ -42,6 +42,7 @@ __all__ = [
     "on_submitted", "on_admitted", "on_prefill", "on_decode_chunk",
     "on_terminal",
     "request_spans", "validate_request_spans", "slot_assignments_from_spans",
+    "assert_well_nested",
 ]
 
 QUEUE_TRACK = "serving queue"
@@ -56,13 +57,25 @@ def _us(t_s: float) -> int:
     return int(t_s * 1e6)
 
 
+def _targs(req, **kw) -> dict:
+    """Common span args: trace_id always; the fleet attempt number when
+    this request is a fleet dispatch (attempt >= 1) — the key the merged
+    cross-process timeline joins attempt-1/attempt-2 replays on."""
+    args = {"trace_id": req.trace_id}
+    attempt = getattr(req, "attempt", 0)
+    if attempt:
+        args["attempt"] = attempt
+    args.update(kw)
+    return args
+
+
 def on_submitted(req) -> None:
     if not _tr.active():
         return
     _tr.record_instant(
         "submitted", _us(req.submitted_t), cat=CAT, track=QUEUE_TRACK,
-        args={"trace_id": req.trace_id, "prompt_len": req.prompt_len,
-              "max_new_tokens": req.max_new_tokens})
+        args=_targs(req, prompt_len=req.prompt_len,
+                    max_new_tokens=req.max_new_tokens))
 
 
 def on_admitted(req, slot: int) -> None:
@@ -73,7 +86,7 @@ def on_admitted(req, slot: int) -> None:
         "queued", _us(req.submitted_t),
         _us(req.admitted_t) - _us(req.submitted_t), cat=CAT,
         track=QUEUE_TRACK,
-        args={"trace_id": req.trace_id, "slot": slot})
+        args=_targs(req, slot=slot))
 
 
 def on_prefill(req, slot: int, bucket: int, t0_s: float, t1_s: float) -> None:
@@ -82,8 +95,7 @@ def on_prefill(req, slot: int, bucket: int, t0_s: float, t1_s: float) -> None:
     _tr.record_span(
         "prefill(b=%d)" % bucket, _us(t0_s), _us(t1_s) - _us(t0_s), cat=CAT,
         track=slot_track(slot),
-        args={"trace_id": req.trace_id, "bucket": bucket,
-              "prompt_len": req.prompt_len})
+        args=_targs(req, bucket=bucket, prompt_len=req.prompt_len))
 
 
 def on_decode_chunk(reqs_by_slot: Sequence, fuse: int, t0_s: float,
@@ -100,9 +112,8 @@ def on_decode_chunk(reqs_by_slot: Sequence, fuse: int, t0_s: float,
             continue
         _tr.record_span(
             "decode", ts, dur, cat=CAT, track=slot_track(slot),
-            args={"trace_id": req.trace_id, "steps": fuse,
-                  "pages_held": len(req.pages),
-                  "generated": len(req.tokens_out)})
+            args=_targs(req, steps=fuse, pages_held=len(req.pages),
+                        generated=len(req.tokens_out)))
 
 
 def on_terminal(req, state: str, slot: Optional[int]) -> None:
@@ -114,8 +125,7 @@ def on_terminal(req, state: str, slot: Optional[int]) -> None:
         return
     label = {"finished": "retired", "failed": "FAILED",
              "timeout": "TIMEOUT"}.get(state, state)
-    args = {"trace_id": req.trace_id, "state": state,
-            "tokens_out": len(req.tokens_out)}
+    args = _targs(req, state=state, tokens_out=len(req.tokens_out))
     if slot is not None:
         track = slot_track(slot)
         _tr.record_span(
@@ -127,7 +137,7 @@ def on_terminal(req, state: str, slot: Optional[int]) -> None:
         _tr.record_span(
             "queued", _us(req.submitted_t),
             _us(req.finished_t) - _us(req.submitted_t), cat=CAT, track=track,
-            args={"trace_id": req.trace_id, "slot": None})
+            args=_targs(req, slot=None))
     _tr.record_instant(label, _us(req.finished_t), cat=CAT, track=track,
                        args=args)
 
@@ -206,21 +216,26 @@ def validate_request_spans(spans: Sequence[dict], requests: Sequence
                                          s["ts_us"] + s["dur_us"], lo, hi))
             digest["track"] = life["tid"]
         digests[req.trace_id] = digest
-    _assert_well_nested(spans)
+    assert_well_nested(spans)
     return digests
 
 
-def _assert_well_nested(spans: Sequence[dict]) -> None:
-    """Per (pid, tid) SLOT track: any two spans are disjoint or one
+def assert_well_nested(spans: Sequence[dict], cat: str = CAT,
+                       exempt: Sequence[str] = ("queued",)) -> None:
+    """Per (pid, tid) track: any two ``cat`` spans are disjoint or one
     contains the other — the property that makes the Chrome viewer's
-    stacking (and a human's read of the schedule) unambiguous. The queue
-    track is exempt: ``queued`` waits of concurrent requests legitimately
-    overlap partially (they are independent lifelines, not a call stack)."""
+    stacking (and a human's read of the schedule) unambiguous. Span names
+    in ``exempt`` are skipped: request lifelines of concurrent requests
+    (``queued`` waits, fleet ``attempt`` windows) legitimately overlap
+    partially — they are independent lifelines, not a call stack. The
+    fleet validator (tools/fleet_trace.py) reuses this core per merged
+    worker process, which is why the category is a parameter."""
     tracks: Dict[tuple, List[tuple]] = {}
+    exempt = set(exempt)
     for s in spans:
-        if s.get("cat") != CAT or not s.get("dur_us"):
+        if s.get("cat") != cat or not s.get("dur_us"):
             continue
-        if s["name"] == "queued":
+        if s["name"] in exempt:
             continue
         tracks.setdefault((s.get("pid"), s.get("tid")), []).append(
             (s["ts_us"], s["ts_us"] + s["dur_us"], s["name"]))

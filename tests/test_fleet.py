@@ -308,6 +308,181 @@ class TestAggregateTelemetry:
         assert aggregate_telemetry(str(tmp_path)) == {}
         assert aggregate_telemetry(str(tmp_path / "nonexistent")) == {}
 
+    def test_degenerate_rings_flag_not_throw(self, tmp_path):
+        """The three ways a replica's ring goes wrong — never ticked,
+        crashed mid-append, never appeared — each yield a flagged entry,
+        never an exception, never a silent hole."""
+        base = str(tmp_path / "fleet")
+        os.makedirs(os.path.join(base, "replica_0"))  # spawned, no tick yet
+        d1 = os.path.join(base, "replica_1")          # torn tail only
+        os.makedirs(d1)
+        with open(os.path.join(d1, "telemetry_123_0.jsonl"), "w") as f:
+            f.write('{"schema": "paddle_tpu.telemetry/v1", "seq": 1, "tr')
+        agg = aggregate_telemetry(base, expected=[0, 1, 2])
+        assert agg["replica_0"]["flag"] == "no complete samples"
+        assert agg["replica_1"]["flag"] == "no complete samples"
+        assert agg["replica_2"]["flag"] == "ring dir missing"
+        assert all(v["samples"] == 0 for v in agg.values())
+
+    def test_missing_base_with_expected_flags_every_replica(self, tmp_path):
+        agg = aggregate_telemetry(str(tmp_path / "never_made"), expected=[0, 1])
+        assert sorted(agg) == ["replica_0", "replica_1"]
+        assert all(v["flag"] == "ring dir missing" for v in agg.values())
+
+    def test_numeric_replica_order(self, tmp_path):
+        base = str(tmp_path / "fleet")
+        for i in (0, 1, 2, 10):
+            os.makedirs(os.path.join(base, "replica_%d" % i))
+        assert list(aggregate_telemetry(base)) == [
+            "replica_0", "replica_1", "replica_2", "replica_10"]
+
+
+# -- fleet event log ----------------------------------------------------------
+class TestFleetEventLog:
+    def test_round_trip_skips_torn_tail(self, tmp_path):
+        from paddle_tpu.fleet.events import FleetEventLog, read_events
+
+        p = str(tmp_path / "events.jsonl")
+        log = FleetEventLog(p)
+        assert log.armed
+        log.emit("spawn", replica=0)
+        log.emit("kill_detected", replica=0, lost=2)
+        log.close()
+        with open(p, "a") as f:
+            f.write('{"kind": "torn')  # crash mid-append
+        evs = read_events(p)
+        assert [e["kind"] for e in evs] == ["spawn", "kill_detected"]
+        assert len({e["run_id"] for e in evs}) == 1
+        kills = read_events(p, kind="kill_detected")
+        assert len(kills) == 1 and kills[0]["lost"] == 2
+
+    def test_unwritable_path_disarms_never_raises(self, tmp_path):
+        from paddle_tpu.fleet.events import FleetEventLog
+
+        bad = os.path.join(str(tmp_path / "file_not_dir"), "x", "e.jsonl")
+        with open(str(tmp_path / "file_not_dir"), "w") as f:
+            f.write("occupied")
+        log = FleetEventLog(bad)
+        assert not log.armed
+        assert log.emit("spawn", replica=0) is None  # no-op, no raise
+
+
+# -- fleet SLO plane ----------------------------------------------------------
+class TestFleetSLO:
+    def test_merge_fleet_docs_sums_deltas(self):
+        from paddle_tpu.fleet.slo import merge_fleet_docs
+
+        docs = [
+            {"t": 10.0, "dt_s": 2.0,
+             "metrics": {"g": {"type": "gauge", "value": 2.0}},
+             "deltas": {"counters": {"c": 1.0}, "gauges": {"g": 2.0},
+                        "histograms": {"h": {"count": 2, "sum": 10.0,
+                                             "buckets": {"5": 2}}}}},
+            {"t": 11.0, "dt_s": 3.0,
+             "metrics": {"g": {"type": "gauge", "value": 3.0}},
+             "deltas": {"counters": {"c": 2.0}, "gauges": {"g": 3.0},
+                        "histograms": {"h": {"count": 1, "sum": 7.0,
+                                             "buckets": {"10": 1}}}}},
+        ]
+        s = merge_fleet_docs(docs, seq=1)
+        assert s.counter_delta("c") == 3.0
+        assert s.gauge_value("g") == 5.0  # queue depths ADD across a fleet
+        h = s.histogram_delta("h")
+        assert h["count"] == 3 and h["sum"] == 17.0
+        assert h["buckets"] == {"5": 2, "10": 1}
+        assert s.dt_s == 3.0  # widest window, not the sum
+
+    def test_breach_fires_both_scopes_and_cursor_dedupes(self, tmp_path):
+        import json
+
+        from paddle_tpu.fleet.slo import FleetSLO
+        from paddle_tpu.monitor.slo import parse_slos
+
+        base = str(tmp_path)
+        d = os.path.join(base, "replica_0")
+        os.makedirs(d)
+        doc = {"schema": "paddle_tpu.telemetry/v1", "seq": 1, "pid": 1,
+               "t": 1.0, "dt_s": 1.0,
+               "metrics": {"fleet/queue_depth": {"type": "gauge",
+                                                 "value": 9.0}},
+               "deltas": {"counters": {}, "histograms": {},
+                          "gauges": {"fleet/queue_depth": 9.0}}}
+        with open(os.path.join(d, "telemetry_1_0.jsonl"), "w") as f:
+            f.write(json.dumps(doc) + "\n")
+        hits = []
+        slo = FleetSLO(
+            parse_slos("fleet/queue_depth<=5"),
+            on_replica_breach=lambda i, b: hits.append(("replica", i)),
+            on_fleet_breach=lambda b: hits.append(("fleet",)))
+        out = slo.evaluate(base, [0])
+        assert out["replica"].get(0) and out["fleet"]
+        assert ("replica", 0) in hits and ("fleet",) in hits
+        # per-(replica, pid) seq cursor: the same sample never
+        # re-evaluates on the next pass
+        hits.clear()
+        assert slo.evaluate(base, [0]) == {"replica": {}, "fleet": []}
+        assert not hits
+
+
+# -- fleet trace: orphan closure + in-process round trip ----------------------
+class TestFleetTrace:
+    def test_close_orphans_synthesizes_tagged_closures(self):
+        from paddle_tpu.fleet import trace as ftrace
+
+        spans = [
+            {"name": "submitted", "cat": "fleet", "ts_us": 0, "dur_us": 0,
+             "pid": 1, "tid": -1, "track": ftrace.QUEUE_TRACK,
+             "args": {"trace_id": "t1"}},
+            {"name": "queued", "cat": "fleet", "ts_us": 0, "dur_us": 5,
+             "pid": 1, "tid": -1, "track": ftrace.QUEUE_TRACK,
+             "args": {"trace_id": "t1", "attempt": 1}},
+            # a dispatch whose attempt never closed and a request with no
+            # terminal: what a SIGKILLed ROUTER would leave behind
+            {"name": "dispatch", "cat": "fleet", "ts_us": 5, "dur_us": 0,
+             "pid": 1, "tid": -2, "track": "replica 0",
+             "args": {"trace_id": "t1", "attempt": 1}},
+            {"name": "drain", "cat": "fleet", "ts_us": 0, "dur_us": 100,
+             "pid": 1, "tid": -3, "track": ftrace.LIFECYCLE_TRACK,
+             "args": {}},
+        ]
+        out, n = ftrace.close_orphans(spans)
+        assert n == 2
+        synth = [s for s in out if (s.get("args") or {}).get("synthetic")]
+        att = next(s for s in synth if s["name"] == "attempt 1")
+        assert att["args"]["killed"] and att["dur_us"] >= 1
+        term = next(s for s in synth if s["name"] == "failed")
+        assert term["dur_us"] == 0
+        # the validator runs the same closure pass itself on raw spans
+        digests = ftrace.validate_fleet_spans(spans)
+        assert digests["t1"]["synthetic"]
+        assert digests["t1"]["state"] == "failed"
+        assert digests["_meta"]["synthetic_closures"] == 2
+
+    def test_inprocess_router_trace_validates(self, tmp_path):
+        """A traced in-process fleet round trip: the router's own spans
+        alone form a validating request tree (submitted -> queued ->
+        dispatch -> attempt 1 -> terminal), zero synthetic closures."""
+        from paddle_tpu.fleet import trace as ftrace
+
+        trace_dir = str(tmp_path / "trace")
+        router = Router(FleetConfig(
+            replicas=2, mode="inprocess", affinity="round_robin",
+            engine_factory=lambda i: SimEngine(SimConfig(slots=2)),
+            trace_dir=trace_dir))
+        frs = [router.submit([1, i], 4) for i in range(5)]
+        assert router.wait_all(20.0)
+        router.close()
+        spans, manifest, problems = ftrace.load_fragments(trace_dir)
+        assert not problems and manifest.get("run_id")
+        digests = ftrace.validate_fleet_spans(spans)
+        meta = digests.pop("_meta")
+        assert meta["requests"] == 5
+        assert meta["synthetic_closures"] == 0
+        assert all(d["state"] == "finished" and d["attempts"] == [1]
+                   for d in digests.values())
+        trace_ids = {f.trace_id for f in frs}
+        assert set(digests) == trace_ids
+
 
 # -- engine-level prefix cache (real model) -----------------------------------
 @pytest.fixture(scope="module")

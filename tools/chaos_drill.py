@@ -39,8 +39,14 @@
            exactly one terminal state (zero silent drops, zero duplicate
            results), the requeued seeded requests replay BIT-IDENTICAL to
            an unkilled in-process twin, and a rolling restart under
-           traffic terminates nothing as 'rejected'. (This leg dominates
-           the gate's wall time: it spawns and warms real workers.)
+           traffic terminates nothing as 'rejected'. The leg runs with
+           distributed tracing + the fleet event log armed: afterwards
+           the merged clock-aligned timeline must VALIDATE (killed
+           attempt 1 closed synthetically + tagged, requeued attempt 2 of
+           the same trace_id finished) and the event journal must carry
+           the kill/requeue/restart story on one run_id. (This leg
+           dominates the gate's wall time: it spawns and warms real
+           workers.)
 
     python -m tools.chaos_drill --parse 'site@N=kind[:times[:ms]];...'
         Validate a PADDLE_TPU_FAULT_PLAN grammar string and print the
@@ -383,12 +389,16 @@ def drill_serving() -> None:
           "deadline retired TIMEOUT; zero page leaks)")
 
 
-def drill_fleet() -> None:
+def drill_fleet(tmp) -> None:
     """ISSUE 15's fleet chaos drill, on REAL engines in REAL processes:
     SIGKILL a replica mid-traffic -> exactly one terminal outcome per
     request, zero silent drops, and the requeued seeded requests replay
     bit-identical to an unkilled in-process twin; then a rolling restart
-    under traffic terminates nothing as 'rejected'."""
+    under traffic terminates nothing as 'rejected'. The whole leg runs
+    with distributed tracing + the fleet event log armed (ISSUE 16): the
+    merged clock-aligned timeline must VALIDATE after the SIGKILL — the
+    killed attempt 1 closed synthetically and tagged, the requeued
+    attempt 2 of the SAME trace_id finished."""
     from paddle_tpu.fleet import FleetConfig, Router
     from paddle_tpu.fleet import metrics as fm
     from paddle_tpu.models.decoder_lm import DecoderConfig, DecoderLM
@@ -402,9 +412,12 @@ def drill_fleet() -> None:
             "serving": scfg, "warmup": True}
     jobs = [([1 + i, 2, 3, 4], 5) for i in range(10)]
 
+    trace_dir = os.path.join(tmp, "fleet_trace")
+    event_log = os.path.join(tmp, "fleet_events.jsonl")
     router = Router(FleetConfig(replicas=2, mode="process",
                                 affinity="round_robin", engine_spec=spec,
-                                max_outstanding=2))
+                                max_outstanding=2, trace_dir=trace_dir,
+                                event_log=event_log))
     frs = [router.submit(p, m, temperature=0.6, seed=900 + i)
            for i, (p, m) in enumerate(jobs)]
     deadline = time.monotonic() + 30.0
@@ -451,10 +464,38 @@ def drill_fleet() -> None:
     assert "rejected" not in acc.values(), \
         "rolling restart terminally rejected a request: %s" % acc
     assert all(f.state == "finished" and f.tokens for f in frs2)
-    router.close()
+    router.close()  # writes the router fragment + merge manifest
+
+    # the merged cross-process timeline tells the same story the
+    # accounting did — and validates: killed attempt 1 closed + tagged,
+    # attempt 2 of the SAME trace_id finished, worker spans joined
+    from tools import fleet_trace
+
+    digest = fleet_trace.merge(trace_dir)
+    digests = fleet_trace.validate(trace_dir)
+    meta = digests.pop("_meta")
+    assert meta["requests"] == len(jobs) + len(frs2), meta
+    replayed = {t: d for t, d in digests.items() if d["killed"]}
+    assert replayed, "no killed attempt in the merged trace"
+    for tid, d in replayed.items():
+        assert d["state"] == "finished", (tid, d)
+        assert d["killed"][0] == 1 and d["attempts"][-1] >= 2, (tid, d)
+
+    from paddle_tpu.fleet.events import read_events
+
+    evs = read_events(event_log)
+    kinds = {e["kind"] for e in evs}
+    assert {"fleet_start", "kill_detected", "requeue", "restart",
+            "rolling_restart", "fleet_stop"} <= kinds, kinds
+    assert len({e["run_id"] for e in evs}) == 1
+
     print("chaos_drill: fleet drill OK (SIGKILL absorbed exactly-once, "
           "replay bit-identical to unkilled twin, rolling restart "
-          "rejected nothing)")
+          "rejected nothing; merged trace validated — %d requests, "
+          "killed attempt 1 -> finished attempt >=2 on %d request(s))"
+          % (meta["requests"], len(replayed)))
+    print("chaos_drill: fleet trace %s (merged: %s), events %s"
+          % (trace_dir, digest["out"], event_log))
 
 
 def selftest() -> int:
@@ -474,7 +515,7 @@ def selftest() -> int:
         drill_exactly_once(tmp)
         drill_training(tmp)
         drill_serving()
-        drill_fleet()
+        drill_fleet(tmp)
     dt = time.perf_counter() - t0
     print("chaos_drill selftest: OK (%.1fs)" % dt)
     return 0

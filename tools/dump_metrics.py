@@ -111,7 +111,10 @@ def _expand_watch_dirs(telemetry_dir) -> list:
     """Normalize the --watch dir argument: a single dir, a comma-joined
     list, or a Python list — plus one level of fleet expansion: a dir
     containing ``replica_*/`` subdirs (the router's ``telemetry_base``)
-    tails every replica's ring, merged."""
+    tails every replica's ring, merged — in NUMERIC replica order
+    (replica_10 after replica_9, not between replica_1 and replica_2)."""
+    from paddle_tpu.fleet.router import _replica_index
+
     if telemetry_dir is None:
         return []
     dirs = (list(telemetry_dir) if isinstance(telemetry_dir, (list, tuple))
@@ -119,11 +122,12 @@ def _expand_watch_dirs(telemetry_dir) -> list:
     out = []
     for d in dirs:
         subs = sorted(
-            os.path.join(d, name) for name in
-            (os.listdir(d) if os.path.isdir(d) else [])
-            if name.startswith("replica_")
-            and os.path.isdir(os.path.join(d, name)))
-        out.extend(subs if subs else [d])
+            (name for name in
+             (os.listdir(d) if os.path.isdir(d) else [])
+             if name.startswith("replica_")
+             and os.path.isdir(os.path.join(d, name))),
+            key=_replica_index)
+        out.extend([os.path.join(d, name) for name in subs] or [d])
     return out
 
 
@@ -593,6 +597,11 @@ def selftest() -> int:
             exp.stop()
         assert _expand_watch_dirs(base) == [
             os.path.join(base, "replica_0"), os.path.join(base, "replica_1")]
+        # numeric, not lexicographic: replica_10 tails AFTER replica_2
+        for i in (2, 10):
+            os.makedirs(os.path.join(base, "replica_%d" % i))
+        assert _expand_watch_dirs(base) == [
+            os.path.join(base, "replica_%d" % i) for i in (0, 1, 2, 10)]
         buf = io.StringIO()
         with contextlib.redirect_stdout(buf):
             watch(0.0, base, max_ticks=1)

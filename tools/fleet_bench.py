@@ -51,15 +51,20 @@ def _sim_spec(slots: int, step_ms: float) -> dict:
 
 def run_scaling_leg(n_replicas: int, n_requests: int = 96,
                     step_ms: float = 4.0, slots: int = 4,
-                    max_new: int = 16, telemetry_base: str = None) -> dict:
+                    max_new: int = 16, telemetry_base: str = None,
+                    trace_dir: str = None, event_log: str = None) -> dict:
     """Drive ``n_requests`` through ``n_replicas`` process workers (sim
-    engines); returns the throughput digest the ledger gates."""
+    engines); returns the throughput digest the ledger gates.
+    ``trace_dir``/``event_log`` arm the fleet observability plane for
+    the leg (they also fall back to the PADDLE_TPU_FLEET_* env knobs via
+    FleetConfig)."""
     from paddle_tpu.fleet import FleetConfig, Router
 
     router = Router(FleetConfig(
         replicas=n_replicas, mode="process", affinity="round_robin",
         engine_spec=_sim_spec(slots, step_ms), max_outstanding=slots * 2,
-        telemetry_base=telemetry_base))
+        telemetry_base=telemetry_base, trace_dir=trace_dir,
+        event_log=event_log))
     try:
         t0 = time.perf_counter()
         frs = [router.submit([1, 2, i % 13], max_new)
@@ -71,15 +76,27 @@ def run_scaling_leg(n_replicas: int, n_requests: int = 96,
         assert ok and not bad, "scaling leg dropped requests: %s" % bad
         lat = sorted(f.latency_s * 1e3 for f in frs)
         snap = router.snapshot()
-        return {"replicas": n_replicas, "requests": n_requests,
-                "qps": round(n_requests / dt, 3),
-                "tokens_per_sec": round(
-                    sum(len(f.tokens) for f in frs) / dt, 1),
-                "p50_ms": round(sorted_percentile(lat, 50), 3),
-                "p99_ms": round(sorted_percentile(lat, 99), 3),
-                "wall_s": round(dt, 3),
-                "streams": [f.tokens for f in frs],
-                "snapshot": snap}
+        out = {"replicas": n_replicas, "requests": n_requests,
+               "qps": round(n_requests / dt, 3),
+               "tokens_per_sec": round(
+                   sum(len(f.tokens) for f in frs) / dt, 1),
+               "p50_ms": round(sorted_percentile(lat, 50), 3),
+               "p99_ms": round(sorted_percentile(lat, 99), 3),
+               "wall_s": round(dt, 3),
+               "streams": [f.tokens for f in frs],
+               "snapshot": snap}
+        # armed observability artifacts ride the digest (and the tail /
+        # ledger record), so a bench run's trace merges and rings tail
+        # without spelunking for paths
+        if router.cfg.trace_dir:
+            out["trace_dir"] = router.cfg.trace_dir
+        if router.cfg.event_log:
+            out["event_log"] = router.cfg.event_log
+        if telemetry_base:
+            out["telemetry_dirs"] = [
+                os.path.join(telemetry_base, "replica_%d" % i)
+                for i in range(n_replicas)]
+        return out
     finally:
         router.close()
 
@@ -260,6 +277,75 @@ def _selftest_rolling_restart() -> None:
     router.close()
 
 
+def _selftest_fleet_slo() -> None:
+    """Fleet-SLO drill (ISSUE 16 acceptance): a per-replica latency fault
+    (installed through the ordinary PADDLE_TPU_FAULT_PLAN grammar via
+    ``spec_overrides``) breaches the p99 spec at BOTH scopes — replica 0
+    alone and the fleet aggregate — ticks ``slo/breaches``, degrades
+    replica 0 in the snapshot, and journals the breach in the event log
+    joined to the spawns by run_id."""
+    from paddle_tpu.fleet import FleetConfig, Router
+    from paddle_tpu.fleet.events import read_events
+    from paddle_tpu.monitor import metrics as mx
+    from paddle_tpu.monitor.slo import parse_slos
+
+    # pin the workers' export interval above the run length: each worker
+    # ring then holds exactly ONE sample — the final partial interval
+    # flushed at release — so the close()-time evaluation judges the whole
+    # run's latency distribution deterministically
+    prev = os.environ.get("PADDLE_TPU_TELEMETRY_INTERVAL_S")
+    os.environ["PADDLE_TPU_TELEMETRY_INTERVAL_S"] = "60"
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            base = os.path.join(td, "tele")
+            elog = os.path.join(td, "events.jsonl")
+            b0 = mx.counter("slo/breaches").value
+            router = Router(FleetConfig(
+                replicas=2, mode="process", affinity="round_robin",
+                engine_spec=_sim_spec(slots=2, step_ms=2.0),
+                max_outstanding=4, telemetry_base=base, event_log=elog,
+                slos=parse_slos("serving/request_latency_ms:p99<=150"),
+                spec_overrides={0: {
+                    "fault_plan": "serving.decode@1=latency:999:60"}}))
+            try:
+                frs = [router.submit([3, i], 8) for i in range(10)]
+                assert router.wait_all(60.0), router.accounting()
+                assert all(f.state == "finished" for f in frs)
+            finally:
+                router.close()  # workers flush final samples -> SLO pass
+
+            assert mx.counter("slo/breaches").value > b0, \
+                "faulted replica breached no SLO"
+            snap = router.snapshot()
+            slo = snap["slo"]
+            assert slo["specs"] == ["serving/request_latency_ms:p99"], slo
+            assert 0 in slo["breached_replicas"], slo
+            assert slo["fleet_breaches"] >= 1 and slo["fleet_breach"], slo
+            r0 = next(r for r in snap["replicas"]
+                      if r["name"] == "replica-0")
+            assert r0["health"]["status"] == "degraded" \
+                and r0["health"].get("slo_breached"), r0
+            r1 = next(r for r in snap["replicas"]
+                      if r["name"] == "replica-1")
+            assert not r1["health"].get("slo_breached"), \
+                "the unfaulted replica was marked breached: %s" % r1
+
+            evs = read_events(elog)
+            breaches = [e for e in evs if e["kind"] == "slo_breach"]
+            scopes = {e.get("scope") for e in breaches}
+            assert {"replica", "fleet"} <= scopes, breaches
+            assert any(e.get("replica") == 0 for e in breaches), breaches
+            spawn_rids = {e["run_id"] for e in evs if e["kind"] == "spawn"}
+            assert len(spawn_rids) == 1 and all(
+                e["run_id"] in spawn_rids for e in breaches), \
+                "breach events not joinable to spawns by run_id"
+    finally:
+        if prev is None:
+            os.environ.pop("PADDLE_TPU_TELEMETRY_INTERVAL_S", None)
+        else:
+            os.environ["PADDLE_TPU_TELEMETRY_INTERVAL_S"] = prev
+
+
 def selftest() -> int:
     t0 = time.perf_counter()
     from paddle_tpu.monitor import metrics as mx
@@ -269,6 +355,7 @@ def selftest() -> int:
     _selftest_kill_replay()
     _selftest_process_kill()
     _selftest_rolling_restart()
+    _selftest_fleet_slo()
 
     # scaling: 1 vs 4 sim-engine workers over the real worker protocol.
     # identical streams at every width (seeded, position-keyed), and >=3x
@@ -293,6 +380,9 @@ def selftest() -> int:
         tele = aggregate_telemetry(os.path.join(td, "f4"))
         assert len(tele) == 4, "expected 4 replica rings: %s" % list(tele)
         assert all(v["samples"] >= 1 for v in tele.values()), tele
+        # armed legs surface their artifact paths in the digest (the
+        # bench tail + ledger extra are built from these)
+        assert len(leg4["telemetry_dirs"]) == 4, leg4
 
     prefix = run_prefix_leg()
 
@@ -348,20 +438,38 @@ def selftest() -> int:
 
 
 def fleet_bench(n_requests: int = 96, replica_counts=(1, 2, 4),
-                step_ms: float = 4.0, slots: int = 4) -> dict:
+                step_ms: float = 4.0, slots: int = 4,
+                telemetry_base: str = None) -> dict:
     """The bench body ``--selftest`` does NOT run: per-replica-count QPS
-    legs + the real-engine prefix leg, as one JSON digest."""
+    legs + the real-engine prefix leg, as one JSON digest. The fleet
+    observability env knobs arm the legs: PADDLE_TPU_FLEET_TRACE_DIR and
+    a --telemetry-base get a per-leg subdir (each leg is its own fleet —
+    one manifest/ring set per leg), PADDLE_TPU_FLEET_EVENTS is shared
+    (the journal appends; legs are told apart by run_id + fleet_start)."""
     from paddle_tpu.monitor import metrics as mx
 
     mx.enable()
     res = {"host_cpus": os.cpu_count(), "step_ms": step_ms, "slots": slots}
+    trace_base = (os.environ.get("PADDLE_TPU_FLEET_TRACE_DIR") or "").strip()
     legs = {}
     for n in replica_counts:
-        leg = run_scaling_leg(n, n_requests=n_requests, step_ms=step_ms,
-                              slots=slots)
+        name = "replicas_%d" % n
+        leg = run_scaling_leg(
+            n, n_requests=n_requests, step_ms=step_ms, slots=slots,
+            telemetry_base=(os.path.join(telemetry_base, name)
+                            if telemetry_base else None),
+            trace_dir=(os.path.join(trace_base, name)
+                       if trace_base else None))
         leg.pop("streams", None)  # bulky; identical across counts anyway
-        legs["replicas_%d" % n] = leg
+        legs[name] = leg
     res["scaling"] = legs
+    obs = {}
+    for key in ("trace_dir", "event_log", "telemetry_dirs"):
+        got = {n: leg[key] for n, leg in legs.items() if key in leg}
+        if got:
+            obs[key] = got
+    if obs:
+        res["observability"] = obs
     base = legs.get("replicas_%d" % replica_counts[0])
     top = legs.get("replicas_%d" % replica_counts[-1])
     if base and top:
@@ -390,6 +498,8 @@ def main(argv=None) -> int:
             kw["step_ms"] = float(next(it))
         elif key == "slots":
             kw["slots"] = int(next(it))
+        elif key == "telemetry_base":
+            kw["telemetry_base"] = next(it)
         else:
             print("unknown flag %r" % a, file=sys.stderr)
             return 2
@@ -397,17 +507,24 @@ def main(argv=None) -> int:
     try:
         # one ledger record per replica count (plus the prefix leg), so
         # perf_gate --check gates fleet QPS per width like every other
-        # bench kind (armed via PADDLE_TPU_RUN_LEDGER)
+        # bench kind (armed via PADDLE_TPU_RUN_LEDGER); when the
+        # observability plane was armed, the artifact paths ride the
+        # record's extra block so a regression's run_id leads straight to
+        # its trace/rings/events
         from paddle_tpu.monitor import runlog
 
+        obs = res.get("observability")
         for name, leg in res["scaling"].items():
             cfg = {k: v for k, v in leg.items()
                    if isinstance(v, (int, float))}
+            leg_obs = {key: paths[name] for key, paths in (obs or {}).items()
+                       if name in paths}
             runlog.record_run("fleet_bench",
                               {"fleet_%s" % name: cfg,
                                "fleet_prefix": {
                                    k: v for k, v in res["prefix"].items()
-                                   if isinstance(v, (int, float))}})
+                                   if isinstance(v, (int, float))}},
+                              extra=leg_obs or None)
         res.update(runlog.tail_info())
     except Exception as e:
         res["run_ledger_error"] = repr(e)[:80]

@@ -1,0 +1,240 @@
+"""Live fleet view: per-replica QPS/p99/queue/health from on-disk state.
+
+    python -m tools.fleet_top <telemetry_base> [--events FILE]
+                              [--watch [SECONDS]] [--ticks N]
+        Render the fleet observability plane with NO control channel to
+        the router — everything comes off disk: ``snapshot.json`` (the
+        router drops it atomically under its telemetry base every
+        observation tick), the per-replica telemetry rings
+        (``replica_<i>/``), and optionally the fleet event log tail.
+        ``--watch`` redraws every SECONDS (default 2.0) until ^C;
+        ``--ticks`` bounds the redraws (for drivers/tests). One replica
+        per row, in NUMERIC index order (replica_10 after replica_9),
+        with ring freshness and degradation flags inline.
+
+    python -m tools.fleet_top --selftest
+        <10s: drives a tiny process-mode sim fleet with telemetry + an
+        event log, then asserts the rendered view carries the replica
+        rows, states, SLO section and event tail, and that watch mode
+        ticks without a router alive (the files are the interface).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def load_view(base: str, events_path: str = None,
+              events_tail: int = 5) -> dict:
+    """Everything one render needs, read fresh from disk. Tolerant of
+    every partial state: no snapshot yet, no rings yet, no event log —
+    the view says what is missing instead of failing."""
+    from paddle_tpu.fleet.events import read_events
+    from paddle_tpu.fleet.router import aggregate_telemetry
+
+    view = {"base": base, "snapshot": None, "telemetry": {}, "events": []}
+    snap_path = os.path.join(base, "snapshot.json")
+    try:
+        with open(snap_path) as f:
+            view["snapshot"] = json.load(f)
+    except (OSError, ValueError):
+        pass
+    view["telemetry"] = aggregate_telemetry(base)
+    if events_path is None and view["snapshot"]:
+        events_path = view["snapshot"].get("event_log")
+    if events_path:
+        view["events"] = read_events(events_path)[-events_tail:]
+    return view
+
+
+def _ring_row(entry: dict) -> str:
+    if not entry:
+        return "ring: none"
+    if entry.get("flag"):
+        return "ring: %s" % entry["flag"]
+    last = entry.get("last") or {}
+    age = max(0.0, time.time() - float(last.get("t", 0.0)))
+    retired = ((last.get("metrics") or {})
+               .get("serving/requests_retired") or {}).get("value")
+    return "ring: %d samples, %.1fs old%s" % (
+        entry.get("samples", 0), age,
+        ", retired=%d" % retired if retired is not None else "")
+
+
+def render(view: dict) -> str:
+    lines = []
+    snap = view.get("snapshot")
+    if snap:
+        states = " ".join("%s=%d" % kv
+                          for kv in sorted((snap.get("states") or {})
+                                           .items()))
+        lines.append("fleet %s  up %.1fs  queue=%d  requests=%d  %s"
+                     % (snap.get("run_id", "?"),
+                        snap.get("uptime_s", 0.0),
+                        snap.get("queue_depth", 0),
+                        snap.get("requests", 0), states))
+        slo = snap.get("slo")
+        if slo:
+            lines.append(
+                "slo: %s  breached_replicas=%s  fleet_breaches=%d%s"
+                % (",".join(slo.get("specs") or []) or "-",
+                   slo.get("breached_replicas") or [],
+                   slo.get("fleet_breaches", 0),
+                   "  LAST: %s" % (slo.get("fleet_breach") or {}).get("slo")
+                   if slo.get("fleet_breach") else ""))
+    else:
+        lines.append("fleet <no snapshot.json under %s>" % view["base"])
+    lines.append("%-12s %-6s %-9s %8s %9s %8s %9s  %s"
+                 % ("replica", "alive", "status", "inflight", "completed",
+                    "qps", "p99_ms", "telemetry"))
+    rows = {r["name"]: r for r in (snap or {}).get("replicas") or []}
+    names = list(rows)
+    for tname in view.get("telemetry") or {}:
+        rname = tname.replace("replica_", "replica-")
+        if rname not in names:
+            names.append(rname)
+    for name in names:
+        r = rows.get(name, {})
+        h = r.get("health") or {}
+        status = h.get("status", "?")
+        if h.get("slo_breached"):
+            status += "(slo)"
+        ring = (view.get("telemetry") or {}).get(
+            name.replace("replica-", "replica_"))
+        qps = r.get("qps", "-")
+        p99 = r.get("p99_ms", "-")
+        lines.append("%-12s %-6s %-9s %8s %9s %8s %9s  %s"
+                     % (name, r.get("alive", "?"), status,
+                        r.get("inflight", "-"), r.get("completed", "-"),
+                        "%.2f" % qps if isinstance(qps, float) else qps,
+                        "%.1f" % p99 if isinstance(p99, float) else p99,
+                        _ring_row(ring)))
+    for ev in view.get("events") or []:
+        extra = ev.get("replica")
+        lines.append("event %-14s %s%s"
+                     % (ev.get("kind"),
+                        "replica=%s " % extra if extra is not None else "",
+                        ev.get("trace_id") or ev.get("why") or ""))
+    return "\n".join(lines)
+
+
+def watch(base: str, interval_s: float = 2.0, events_path: str = None,
+          max_ticks: int = None) -> int:
+    ticks = 0
+    try:
+        while max_ticks is None or ticks < max_ticks:
+            out = render(load_view(base, events_path))
+            if ticks:
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(out)
+            ticks += 1
+            if max_ticks is not None and ticks >= max_ticks:
+                break
+            time.sleep(interval_s)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+# -- selftest -----------------------------------------------------------------
+
+def selftest() -> int:
+    import tempfile
+
+    t0 = time.perf_counter()
+    from paddle_tpu.fleet import FleetConfig, Router
+
+    with tempfile.TemporaryDirectory() as td:
+        base = os.path.join(td, "tele")
+        elog = os.path.join(td, "events.jsonl")
+        router = Router(FleetConfig(
+            replicas=2, mode="process", affinity="round_robin",
+            engine_spec={"engine": "sim",
+                         "sim": {"slots": 2, "step_ms": 2.0}},
+            telemetry_base=base, event_log=elog,
+            slos=[]))
+        try:
+            for i in range(6):
+                router.submit([1, i], 8)
+            assert router.wait_all(30.0)
+        finally:
+            router.close()   # workers flush final samples; snapshot drops
+
+        view = load_view(base, elog)
+        assert view["snapshot"] is not None, "router left no snapshot.json"
+        assert len(view["snapshot"]["replicas"]) == 2
+        assert view["telemetry"], "no replica rings under %s" % base
+        out = render(view)
+        assert "replica-0" in out and "replica-1" in out, out
+        assert "finished=6" in out, out
+        assert "fleet_stop" in out or "event" in out, out
+
+        # numeric ordering: a fabricated replica_10 ring must sort after
+        # replica_2, not between replica_1 and replica_2
+        from paddle_tpu.fleet.router import aggregate_telemetry
+
+        for idx in (2, 10):
+            os.makedirs(os.path.join(base, "replica_%d" % idx),
+                        exist_ok=True)
+        order = [n for n in aggregate_telemetry(base)]
+        assert order.index("replica_2") < order.index("replica_10"), order
+
+        # watch mode ticks off disk with no router alive
+        assert watch(base, interval_s=0.01, events_path=elog,
+                     max_ticks=2) == 0
+
+    print("fleet_top selftest: OK (%.1fs)" % (time.perf_counter() - t0))
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 0
+    if argv and argv[0] == "--selftest":
+        return selftest()
+
+    def opt(name, default=None):
+        if name in argv:
+            i = argv.index(name)
+            argv.pop(i)
+            return argv.pop(i)
+        return default
+
+    events_path = opt("--events")
+    ticks = opt("--ticks")
+    interval = None
+    if "--watch" in argv:
+        i = argv.index("--watch")
+        argv.pop(i)
+        interval = 2.0
+        if i < len(argv) and not argv[i].startswith("-") \
+                and not os.path.isdir(argv[i]):
+            try:
+                interval = float(argv[i])
+                argv.pop(i)
+            except ValueError:
+                pass
+    if len(argv) != 1:
+        print("usage: python -m tools.fleet_top <telemetry_base> "
+              "[--events FILE] [--watch [SECONDS]] [--ticks N]",
+              file=sys.stderr)
+        return 2
+    base = argv[0]
+    if interval is not None:
+        return watch(base, interval, events_path,
+                     max_ticks=int(ticks) if ticks else None)
+    print(render(load_view(base, events_path)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

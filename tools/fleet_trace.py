@@ -1,0 +1,246 @@
+"""Merge a fleet trace dir into ONE clock-aligned Perfetto timeline.
+
+    python -m tools.fleet_trace <trace_dir> [--out FILE] [--validate]
+                                [--slack-us N]
+        Load ``fleet_manifest.json`` + every fragment a traced fleet run
+        left behind (the router's spans plus one fragment per worker
+        spawn), shift each worker's timestamps by its handshake-measured
+        clock offset, and write one Chrome trace with per-process tracks
+        (``fleet router``, ``fleet worker replica <i>``) — open it in
+        Perfetto and a request's queued wait, dispatch, worker-side
+        serve/prefill/decode, kill, and requeued replay all line up on
+        one ruler. Default --out: ``<trace_dir>/merged.json``.
+
+        ``--validate`` additionally runs the fleet-level invariant
+        checker (the cross-process analogue of serving.trace.
+        validate_request_spans): every traced request must join into one
+        well-nested tree — >=1 queued span, exactly one terminal,
+        non-overlapping ordered attempts, every worker span inside its
+        attempt window within ``--slack-us`` (default 20000; this is the
+        clock-correction error bound, so an unaligned merge fails here).
+        Orphans a SIGKILL left open are closed synthetically and tagged.
+
+    python -m tools.fleet_trace --selftest
+        <10s, JAX_PLATFORMS=cpu: spins a 2-replica process-mode sim
+        fleet with tracing + event log armed and a 3s clock skew
+        injected into the workers (PADDLE_TPU_TRACE_CLOCK_SKEW_US),
+        SIGKILLs one worker mid-traffic, then asserts: the handshake
+        recovered the injected offset; the merge + --validate pass; the
+        killed attempt 1 and requeued attempt 2 join on one trace_id;
+        the SIGKILLed worker's missing fragment is a flagged problem,
+        not a failure; the merged doc round-trips through
+        tracer.load_spans; and the fleet event log carries
+        spawn/kill_detected/requeue joined on one run_id. The
+        smoke-gate entry (ROADMAP).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def merge(trace_dir: str, out_path: str = None) -> dict:
+    """Merge + write; returns a digest (span/fragment/problem counts)."""
+    from paddle_tpu.fleet import trace as ftrace
+    from paddle_tpu.monitor import tracer
+
+    spans, manifest, problems = ftrace.load_fragments(trace_dir)
+    if out_path is None:
+        out_path = os.path.join(trace_dir, "merged.json")
+    doc = tracer.to_chrome_trace(spans,
+                                 process_names=ftrace.process_names(manifest))
+    with open(out_path, "w") as f:
+        json.dump(doc, f)
+    return {"out": out_path, "spans": len(spans),
+            "fragments": (1 if (manifest.get("router") or {}).get("file")
+                          else 0) + len(manifest.get("workers") or []),
+            "run_id": manifest.get("run_id"),
+            "problems": problems,
+            "offsets_us": {
+                "r%(replica)s_g%(gen)s" % e: e.get("offset_us")
+                for e in manifest.get("workers") or []}}
+
+
+def validate(trace_dir: str, slack_us: int = 20000) -> dict:
+    """Merge in memory and run the fleet invariant checker; returns
+    {trace_id: digest} plus the ``_meta`` entry."""
+    from paddle_tpu.fleet import trace as ftrace
+
+    spans, _, _ = ftrace.load_fragments(trace_dir)
+    return ftrace.validate_fleet_spans(spans, slack_us=slack_us)
+
+
+# -- selftest -----------------------------------------------------------------
+
+_SKEW_US = 3_000_000
+
+
+def _drill(td: str) -> dict:
+    """One traced process-mode fleet run with a mid-traffic SIGKILL;
+    returns paths + the router's replica clock measurements."""
+    from paddle_tpu.fleet import FleetConfig, Router
+
+    trace_dir = os.path.join(td, "trace")
+    event_log = os.path.join(td, "fleet_events.jsonl")
+    router = Router(FleetConfig(
+        replicas=2, mode="process", affinity="round_robin",
+        engine_spec={"engine": "sim", "sim": {"slots": 2, "step_ms": 3.0}},
+        max_outstanding=4, trace_dir=trace_dir, event_log=event_log))
+    offsets = {rep.index: rep.clock_offset_us for rep in router._replicas}
+    rtts = {rep.index: rep.clock_rtt_us for rep in router._replicas}
+    try:
+        frs = [router.submit([1, 2, i], 16) for i in range(8)]
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline \
+                and not router._replicas[0].inflight:
+            router.pump()
+            time.sleep(0.002)
+        assert router._replicas[0].inflight, "no traffic reached the victim"
+        router._replicas[0].kill()
+        assert router.wait_all(30.0), router.accounting()
+        acc = router.accounting()
+        assert set(acc.values()) == {"finished"}, acc
+        assert all(f.tokens for f in frs)
+    finally:
+        router.close()
+    return {"trace_dir": trace_dir, "event_log": event_log,
+            "offsets": offsets, "rtts": rtts}
+
+
+def selftest() -> int:
+    t0 = time.perf_counter()
+    # import the tracer BEFORE arming the skew: the skew knob is read at
+    # tracer import, so only the worker processes (fresh interpreters
+    # inheriting the env) run 3s fast — exactly the cross-host clock
+    # disagreement the handshake + merge must correct
+    from paddle_tpu.monitor import tracer  # noqa: F401
+    from paddle_tpu.fleet.events import read_events
+
+    prev = os.environ.get("PADDLE_TPU_TRACE_CLOCK_SKEW_US")
+    os.environ["PADDLE_TPU_TRACE_CLOCK_SKEW_US"] = str(_SKEW_US)
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            run = _drill(td)
+
+            # 1. the handshake recovered the injected skew (tolerance is
+            # generous vs the ~1ms observed RTTs; the merge slack below
+            # is the bound that actually matters)
+            for idx, off in run["offsets"].items():
+                assert abs(off - _SKEW_US) < 250_000, \
+                    "replica %d offset %dus vs injected %dus (rtt %dus)" \
+                    % (idx, off, _SKEW_US, run["rtts"][idx])
+
+            # 2. merge: one timeline, the SIGKILLed worker's fragment is
+            # a flagged hole, everything else loads
+            digest = merge(run["trace_dir"])
+            assert digest["spans"] > 0 and digest["fragments"] >= 3
+            missing = [p for p in digest["problems"]
+                       if p["problem"] == "missing"]
+            assert len(missing) == 1 and missing[0]["replica"] == 0, \
+                digest["problems"]
+
+            # 3. validate: well-nested cross-process trees; the killed
+            # attempt 1 is closed+tagged and attempt 2 of the SAME
+            # trace_id finished. Worker spans sit inside their attempt
+            # windows within the default slack — with a 3s injected skew
+            # this only holds because the offsets were applied.
+            digests = validate(run["trace_dir"])
+            meta = digests.pop("_meta")
+            assert meta["requests"] == 8, meta
+            replayed = {t: d for t, d in digests.items() if d["killed"]}
+            assert replayed, "SIGKILL mid-traffic produced no killed attempt"
+            for tid, d in replayed.items():
+                assert d["state"] == "finished", (tid, d)
+                assert d["killed"][0] == 1 and d["attempts"][-1] >= 2, \
+                    (tid, d)
+                assert d["outcomes"][d["attempts"][-1]] == "finished", \
+                    (tid, d)
+            joined = [d for d in digests.values() if d["worker_spans"] > 0]
+            assert joined, "no worker-side spans joined the merged tree"
+
+            # 4. the merged artifact is a loadable Chrome trace that
+            # round-trips through the tracer's reader
+            from tools.dump_metrics import validate_chrome_trace
+
+            with open(digest["out"]) as f:
+                doc = json.load(f)
+            validate_chrome_trace(doc)
+            spans_back = tracer.load_spans(digest["out"])
+            assert len(spans_back) >= digest["spans"]
+
+            # 5. event log: lifecycle story joined on one run_id
+            evs = read_events(run["event_log"])
+            kinds = {e["kind"] for e in evs}
+            assert {"fleet_start", "spawn", "kill_detected", "requeue",
+                    "restart", "fleet_stop"} <= kinds, kinds
+            assert len({e["run_id"] for e in evs}) == 1
+            kill = next(e for e in evs if e["kind"] == "kill_detected")
+            assert kill["replica"] == 0 and kill["lost"] >= 1, kill
+    finally:
+        if prev is None:
+            os.environ.pop("PADDLE_TPU_TRACE_CLOCK_SKEW_US", None)
+        else:
+            os.environ["PADDLE_TPU_TRACE_CLOCK_SKEW_US"] = prev
+
+    print("fleet_trace selftest: OK (%.1fs)  offsets %s (injected %dus), "
+          "%d spans merged, killed attempt 1 -> finished attempt 2 on %d "
+          "request(s)"
+          % (time.perf_counter() - t0,
+             {i: o for i, o in run["offsets"].items()}, _SKEW_US,
+             digest["spans"], len(replayed)))
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 0
+    if argv and argv[0] == "--selftest":
+        return selftest()
+
+    def opt(name, default=None):
+        if name in argv:
+            i = argv.index(name)
+            argv.pop(i)
+            return argv.pop(i)
+        return default
+
+    out = opt("--out")
+    slack_us = int(opt("--slack-us", "20000"))
+    do_validate = "--validate" in argv
+    if do_validate:
+        argv.remove("--validate")
+    if len(argv) != 1:
+        print("usage: python -m tools.fleet_trace <trace_dir> [--out FILE] "
+              "[--validate] [--slack-us N]", file=sys.stderr)
+        return 2
+    trace_dir = argv[0]
+    digest = merge(trace_dir, out)
+    if do_validate:
+        digests = validate(trace_dir, slack_us=slack_us)
+        meta = digests.pop("_meta")
+        digest["validated"] = {
+            "requests": meta["requests"],
+            "synthetic_closures": meta["synthetic_closures"],
+            "states": {},
+            "replayed": sorted(t for t, d in digests.items()
+                               if len(d["attempts"]) > 1),
+        }
+        for d in digests.values():
+            st = digest["validated"]["states"]
+            st[d["state"]] = st.get(d["state"], 0) + 1
+    print(json.dumps(digest, indent=1, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

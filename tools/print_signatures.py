@@ -57,6 +57,9 @@ MODULES = [
     "paddle_tpu.fleet.protocol",
     "paddle_tpu.fleet.replica",
     "paddle_tpu.fleet.router",
+    "paddle_tpu.fleet.trace",
+    "paddle_tpu.fleet.slo",
+    "paddle_tpu.fleet.events",
     "paddle_tpu.reliability",
     "paddle_tpu.reliability.faults",
     "paddle_tpu.reliability.supervisor",
