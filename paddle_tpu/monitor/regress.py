@@ -60,7 +60,8 @@ _LOWER_BETTER = ("latency", "_ms", "ms_", "p99", "p95", "p50", "step_time",
                  "wall", "overhead", "wait", "stall", "ttft")
 _HIGHER_BETTER = ("eps", "examples_per_sec", "steps_per_sec", "qps", "mfu",
                   "tokens_per_sec", "throughput", "efficiency", "speedup",
-                  "ratio")
+                  "ratio", "acceptance_rate", "accept_", "hit_rate",
+                  "per_dispatch")
 
 
 def metric_direction(name: str) -> int:

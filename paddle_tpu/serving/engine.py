@@ -117,6 +117,19 @@ class ServingConfig:
     falls back to the fp cache otherwise — serving must come up even with
     no calibration table on disk.
 
+    ``speculation`` arms speculative decoding (serving.speculative): the
+    engine-default draft k per scheduler tick — ``0`` off, a positive int
+    an explicit k (capped at ``speculative.SPEC_K_CAP``), ``"auto"`` the
+    autotuned k (tune table, kernel key ``serving.speculation_k``,
+    bucketed by slot count; ``speculation_source`` records which layer
+    answered — off/explicit/tuned/shipped/default — exactly like
+    ``decode_fuse_source``). ``None`` defers to the
+    ``PADDLE_TPU_SPECULATION`` env var (same grammar; unset means off).
+    ``spec_drafter`` names the drafter (``"ngram"`` — the zero-weight
+    prompt-lookup drafter). Per-request ``submit(speculation=...)``
+    overrides the default. Speculation silently disables when the model
+    lacks the ``verify`` contract method.
+
     Failure policy: ``decode_retries`` bounds in-place retries of a decode
     dispatch whose failure classifies as transient
     (:func:`paddle_tpu.reliability.faults.classify`); past the budget — or
@@ -145,7 +158,8 @@ class ServingConfig:
                  slos: Optional[Sequence] = None,
                  drain_timeout_s: float = 30.0,
                  kv_dtype: Optional[str] = None,
-                 prefix_cache_pages: int = 0):
+                 prefix_cache_pages: int = 0,
+                 speculation=None, spec_drafter: str = "ngram"):
         if kv_dtype not in (None, "int8"):
             raise ValueError("kv_dtype must be None or 'int8', got %r"
                              % (kv_dtype,))
@@ -191,6 +205,19 @@ class ServingConfig:
             raise ValueError(
                 "prefix_cache_pages=%d must leave serving pages free "
                 "(num_pages=%d)" % (self.prefix_cache_pages, self.num_pages))
+        from .speculative import parse_speculation
+
+        self.spec_drafter = str(spec_drafter)
+        if speculation is None:
+            speculation = os.environ.get("PADDLE_TPU_SPECULATION") or None
+        spec = parse_speculation(speculation)
+        if spec == "auto":
+            spec, self.speculation_source = self._tuned_speculation_k()
+        else:
+            self.speculation_source = "off" if not spec else "explicit"
+        self.speculation = max(0, int(spec or 0))
+        if self.speculation == 0:
+            self.speculation_source = "off"
 
     def _tuned_decode_fuse(self):
         """(value, source) from the autotuned config table; (1, "default")
@@ -201,6 +228,15 @@ class ServingConfig:
         from .. import tune
 
         return tune.resolve_decode_fuse(self.slots)
+
+    def _tuned_speculation_k(self):
+        """(value, source) for ``speculation="auto"`` from the autotuned
+        config table — same contract as :meth:`_tuned_decode_fuse`: a
+        missing/corrupt table yields the shipped-math default, never an
+        exception."""
+        from .. import tune
+
+        return tune.resolve_speculation_k(self.slots)
 
 
 class ServingEngine:
@@ -263,6 +299,17 @@ class ServingEngine:
         self._prefill_exe: Dict[int, Any] = {}   # bucket -> AOT executable
         self._decode_exe: Dict[int, Any] = {}    # fuse length -> executable
         self._resume_exe: Dict[int, Any] = {}    # remainder bucket -> exe
+        self._verify_exe: Dict[int, Any] = {}    # window width -> executable
+        # speculative decoding: needs the model's ``verify`` contract
+        # method; without it every speculation knob silently resolves off
+        # (serving must come up on a decode-only model)
+        self._spec_capable = hasattr(model, "verify")
+        from .speculative import make_drafter
+
+        self._drafter = make_drafter(self.cfg.spec_drafter)
+        self._spec_k = np.zeros((b,), np.int32)  # per-slot resolved draft k
+        self._spec_auto: Optional[tuple] = None  # cached "auto" resolution
+        self._spec_enabled = False  # any slot ever armed with k > 0
         # fleet prefix cache: host-side index of donated prompt-prefix KV
         # pages (paged layout only; see paddle_tpu.fleet.prefix_cache)
         self.prefix_cache = None
@@ -361,7 +408,8 @@ class ServingEngine:
                deadline_s: Optional[float] = None,
                temperature: float = 0.0, top_k: int = 0,
                seed: Optional[int] = None,
-               trace_id: Optional[str] = None, attempt: int = 0) -> Request:
+               trace_id: Optional[str] = None, attempt: int = 0,
+               speculation=None) -> Request:
         """Queue a request. Raises ``ValueError`` for a request that can
         NEVER be served at this geometry, and ``BackpressureError`` when
         the bounded queue is full (shed/retry — transient). ``deadline_s``
@@ -369,7 +417,11 @@ class ServingEngine:
         request is retired with TIMEOUT status (queued or running) so it
         stops pinning a slot and KV pages. ``temperature``/``top_k``/
         ``seed`` select device-side sampled decoding for THIS request (see
-        :class:`~.request.Request`); the default is exact greedy."""
+        :class:`~.request.Request`); the default is exact greedy.
+        ``speculation`` overrides the engine's speculative-decoding
+        default for THIS request (``0`` off, int draft-k, ``"auto"`` the
+        tuned k, ``None`` inherit) — pure go-faster knob: the emitted
+        stream is bit-identical either way."""
         if self._draining:
             _sm.DRAIN_REJECTED.inc()
             raise DrainingError(
@@ -377,7 +429,8 @@ class ServingEngine:
                 "requests — re-route to a peer")
         req = Request(prompt, max_new_tokens, deadline_s=deadline_s,
                       temperature=temperature, top_k=top_k, seed=seed,
-                      trace_id=trace_id, attempt=attempt)
+                      trace_id=trace_id, attempt=attempt,
+                      speculation=speculation)
         if req.prompt_len > self.cfg.prompt_buckets[-1]:
             raise ValueError(
                 "prompt length %d exceeds the largest prefill bucket %d"
@@ -524,8 +577,22 @@ class ServingEngine:
                 return "paged", src
         return "gather", "n/a"
 
+    def speculation_info(self) -> tuple:
+        """``(k, drafter_kind, source)`` of the speculative fast path as
+        THIS engine resolves its default — the provenance twin of
+        :meth:`decode_kernel_info`: ``k`` is the engine-default draft
+        width (0 = off, including model-not-capable), ``drafter_kind``
+        names the proposer, ``source`` the answering layer
+        (off/explicit/tuned/shipped/default)."""
+        if not self._spec_capable:
+            return 0, "n/a", "off"
+        k = self.cfg.speculation
+        kind = self._drafter.kind if k > 0 else "off"
+        return k, kind, self.cfg.speculation_source
+
     def stats(self) -> dict:
         kern, kern_src = self.decode_kernel_info()
+        spec_k, spec_kind, spec_src = self.speculation_info()
         out = {
             "layout": self.cache_ops.layout,
             "queued": self.scheduler.queue_depth,
@@ -536,6 +603,9 @@ class ServingEngine:
                                           "explicit"),
             "decode_kernel": kern,
             "decode_kernel_source": kern_src,
+            "speculation": spec_k,
+            "spec_drafter": spec_kind,
+            "speculation_source": spec_src,
             # the layout actually serving (int8 requests silently fall back
             # to fp when uncalibrated — this is where that shows)
             "kv_layout": self.cache_ops.layout,
@@ -742,6 +812,10 @@ class ServingEngine:
         self._temp = self._temp.at[slot].set(req.temperature)
         self._topk = self._topk.at[slot].set(req.top_k)
         self._seed = self._seed.at[slot].set(req.seed)
+        k = self._request_spec_k(req)
+        self._spec_k[slot] = k
+        if k > 0:
+            self._spec_enabled = True
         return None
 
     # -- decode ---------------------------------------------------------------
@@ -760,15 +834,90 @@ class ServingEngine:
         jax.tree_util.tree_map(probe, self._cache)
         return lost
 
+    def _request_spec_k(self, req: Request) -> int:
+        """Resolve the draft k THIS request decodes with: per-request
+        override > engine default; ``"auto"`` goes through the tune table
+        once per engine (cached — admission must not pay a table read per
+        request). 0 when the model lacks the verify contract."""
+        if not self._spec_capable:
+            return 0
+        from .speculative import SPEC_K_CAP
+
+        s = req.speculation
+        if s is None:
+            return self.cfg.speculation
+        if s == "auto":
+            if self._spec_auto is None:
+                from .. import tune
+
+                self._spec_auto = tune.resolve_speculation_k(self.cfg.slots)
+            return min(max(0, int(self._spec_auto[0])), SPEC_K_CAP)
+        return min(max(0, int(s)), SPEC_K_CAP)
+
+    def _build_drafts(self):
+        """Host-side draft pass over the in-flight batch: ask the drafter
+        for up to k proposals per speculative slot (its full prompt +
+        generated history), capped at the slot's remaining emit budget —
+        a draft step past ``max_new``/``max_ctx`` could never be emitted.
+        Returns ``(draft [B,kmax], dlen [B], width)`` or None when no slot
+        proposed anything (the tick then takes the plain fused-decode
+        path — zero speculative overhead for non-speculative traffic)."""
+        if not self._spec_enabled:
+            return None
+        b = self.cfg.slots
+        props: Dict[int, List[int]] = {}
+        kmax = 0
+        for slot in range(b):
+            req = self.scheduler.slot_request(slot)
+            if req is None:
+                continue
+            k = int(self._spec_k[slot])
+            if k <= 0:
+                continue
+            gen = len(req.tokens_out)
+            ln = req.prompt_len + gen - 1
+            k = min(k, req.max_new_tokens - gen, self.cfg.max_seq - ln - 1)
+            if k <= 0:
+                continue
+            prop = self._drafter.propose(
+                list(req.prompt) + req.tokens_out, k)
+            if prop:
+                props[slot] = prop
+                kmax = max(kmax, len(prop))
+        if kmax == 0:
+            return None
+        draft = np.zeros((b, kmax), np.int32)
+        dlen = np.zeros((b,), np.int32)
+        for slot, prop in props.items():
+            draft[slot, :len(prop)] = prop
+            dlen[slot] = len(prop)
+        return draft, dlen, kmax + 1
+
     def _decode_dispatch(self) -> List[Request]:
         """One fused decode dispatch with the recovery ladder: transient
         failures retry in place (bounded by ``decode_retries``); a failure
         that exhausts the budget — or classifies fatal — FAILS the
         in-flight batch (pages reclaimed, requests marked FAILED, device
         slot state reset) and the engine keeps serving the queue. The
-        flight recorder captures the batch spec either way."""
-        fuse = self.cfg.decode_fuse
-        exe = self._get_decode_exe(fuse)
+        flight recorder captures the batch spec either way.
+
+        With speculation armed and the drafter proposing, the tick runs
+        the verify executable instead: ONE windowed forward over each
+        slot's (pending token + draft) window, per-step accept/rollback
+        on device — up to k+1 tokens per dispatch, bit-identical stream
+        (serving.speculative). Rollback is free under the worst-case page
+        reservation: rejected positions sit beyond the rolled-back
+        ``ctx_len``, masked out of every later read until overwritten."""
+        drafts = self._build_drafts()
+        if drafts is not None:
+            draft_np, dlen_np, steps = drafts
+            exe = self._get_verify_exe(steps)
+            extra = (jnp.asarray(draft_np), jnp.asarray(dlen_np))
+        else:
+            dlen_np = None
+            steps = self.cfg.decode_fuse
+            exe = self._get_decode_exe(steps)
+            extra = ()
         t0 = time.perf_counter()
         attempt = 0
         # Pre-dispatch snapshot: on an async backend a failed dispatch often
@@ -787,7 +936,7 @@ class ServingEngine:
                         "injected pool exhaustion at serving.decode")
                 out = exe(self.params, self._cache, self._len, self._tok,
                           self._active, self._gen, self._maxnew,
-                          self._temp, self._topk, self._seed)
+                          self._temp, self._topk, self._seed, *extra)
                 if self.cfg.collect_logits:
                     (self._cache, self._len, self._tok, self._active,
                      self._gen, toks, emitted, fin, logseq) = out
@@ -822,17 +971,32 @@ class ServingEngine:
         t1 = time.perf_counter()
         _trace.on_decode_chunk(
             [self.scheduler.slot_request(s) for s in range(self.cfg.slots)],
-            fuse, t0, t1)
+            steps, t0, t1)
         _sm.DECODE_STEP_MS.observe((t1 - t0) * 1e3)
         _sm.DECODE_DISPATCHES.inc()
-        _sm.DECODE_STEPS.inc(fuse)
+        # a verify dispatch is ONE windowed model step however wide the
+        # window — DECODE_STEPS keeps meaning "model forwards", so
+        # tokens/steps > 1 is exactly the speculative win
+        _sm.DECODE_STEPS.inc(1 if dlen_np is not None else steps)
         _sm.TOKENS_GENERATED.inc(int(emitted.sum()))
+        if dlen_np is not None:
+            # accepted drafts per slot = its run-steps beyond the first
+            # (step 0 consumes the pending token, never a draft)
+            runs = emitted.sum(axis=0)
+            proposed = int(dlen_np.sum())
+            accepted = int(np.maximum(runs - 1, 0).sum())
+            _sm.SPEC_PROPOSED.inc(proposed)
+            _sm.SPEC_ACCEPTED.inc(accepted)
+            _sm.SPEC_REJECTED.inc(proposed - accepted)
+            _sm.SPEC_DRAFTS.inc(int((dlen_np > 0).sum()))
+            _sm.SPEC_VERIFY_DISPATCHES.inc()
+            _sm.SPEC_ACCEPT_RATE.observe(accepted / max(1, proposed))
         finished: List[Request] = []
         for slot in range(self.cfg.slots):
             req = self.scheduler.slot_request(slot)
             if req is None:
                 continue
-            for f in range(fuse):
+            for f in range(steps):
                 if emitted[f, slot]:
                     req.tokens_out.append(int(toks[f, slot]))
                     if logseq is not None:
@@ -964,15 +1128,20 @@ class ServingEngine:
                          "prompt_len": req.prompt_len,
                          "generated": len(req.tokens_out),
                          "max_new_tokens": req.max_new_tokens,
+                         "spec_k": int(self._spec_k[slot]),
                          "pages": list(req.pages)})
         kern, kern_src = self.decode_kernel_info()
+        spec_k, spec_kind, spec_src = self.speculation_info()
         return {"layout": self.cache_ops.layout, "slots": rows,
                 "queue_depth": self.scheduler.queue_depth,
                 "decode_fuse": self.cfg.decode_fuse,
                 "decode_fuse_source": getattr(self.cfg, "decode_fuse_source",
                                               "explicit"),
                 "decode_kernel": kern,
-                "decode_kernel_source": kern_src}
+                "decode_kernel_source": kern_src,
+                "speculation": spec_k,
+                "spec_drafter": spec_kind,
+                "speculation_source": spec_src}
 
     # -- AOT compilation ------------------------------------------------------
     def _get_prefill_exe(self, bucket: int):
@@ -1048,6 +1217,100 @@ class ServingEngine:
         self._decode_exe[fuse] = exe
         return exe
 
+    def _get_verify_exe(self, width: int):
+        """The speculative draft-verify step, compiled once per window
+        width (k+1 — the dict is bounded by ``speculative.SPEC_K_CAP``).
+
+        One windowed model forward scores every slot's window — position
+        0 its pending token, positions 1..k its draft — then a scan
+        replays the plain decode chunk's EXACT per-step state machine
+        over the window's target draws: step j emits
+        ``_sample_tokens(logits_j, ..., position=len+j)`` (the same
+        keying plain decode would use at that step), advances len/gen,
+        applies the same eos/max_new/max_ctx fin logic, and continues
+        speculatively only while the NEXT consumed token (the draft)
+        equals this step's emitted one. Equality-accept against the
+        target's own position-keyed draw is exact speculative sampling
+        for a deterministic drafter (serving.speculative), so both the
+        greedy and the seeded-sampled stream are bit-identical to plain
+        decode. A rejected tail simply never advances ``len`` — its
+        KV rows sit beyond every later read mask until overwritten —
+        and slots with an empty draft degrade to one plain step inside
+        the same dispatch. Output shape contract matches the decode
+        chunk (outs stacked [width, B]), so the host retire loop is
+        shared."""
+        exe = self._verify_exe.get(width)
+        if exe is not None:
+            return exe
+        model, ops, cfg = self.model, self.cache_ops, self.cfg
+        eos = -1 if cfg.eos_id is None else cfg.eos_id
+        max_ctx = cfg.max_seq
+        collect = cfg.collect_logits
+        w = width
+
+        def verify(params, cache, lengths, tokens, active, gen, maxnew,
+                   temp, topk, seed, draft, dlen):
+            b = tokens.shape[0]
+            steps = jnp.arange(w, dtype=jnp.int32)
+            cons = jnp.concatenate([tokens[:, None], draft], axis=1)
+            posw = lengths[:, None] + steps[None, :]
+            # guard every window write to the positions plain decode could
+            # itself reach (step j exists iff gen+j < max_new and
+            # len+j < max_ctx): beyond them the slot's page table holds
+            # UNRESERVED entries (parked on page 0) and an unguarded
+            # scatter would land on another slot's page
+            write_mask = (active[:, None]
+                          & (gen[:, None] + steps[None, :] < maxnew[:, None])
+                          & (posw < max_ctx))
+            logits, cache = model.verify(params, cache, ops, cons, lengths,
+                                         active, write_mask)
+            # the target's own draw at every window position, keyed by the
+            # SAME (seed, absolute position) as plain decode — [B,W] rows
+            # through the [B*W]-batched sampler are per-row identical
+            tt = _sample_tokens(
+                logits.reshape(b * w, -1), jnp.repeat(temp, w),
+                jnp.repeat(topk, w), jnp.repeat(seed, w),
+                posw.reshape(b * w)).reshape(b, w)
+            # token consumed by step j+1 (draft j); dummy past the window
+            nxt_cons = jnp.concatenate(
+                [draft, jnp.zeros((b, 1), jnp.int32)], axis=1)
+
+            def body(carry, xs):
+                ln, tk, ac, sp, gc = carry
+                if collect:
+                    tj, dj, j, lg = xs
+                else:
+                    tj, dj, j = xs
+                run = ac & sp
+                nxt = jnp.where(run, tj, tk)
+                emitted = run
+                gc = gc + run
+                ln = ln + run
+                fin = run & ((nxt == eos) | (gc >= maxnew) | (ln >= max_ctx))
+                ac = ac & ~fin
+                sp = sp & (j < dlen) & (nxt == dj) & ~fin
+                out = (nxt, emitted, fin, lg) if collect \
+                    else (nxt, emitted, fin)
+                return (ln, nxt, ac, sp, gc), out
+
+            xs = (tt.T, nxt_cons.T, steps)
+            if collect:
+                xs = xs + (logits.transpose(1, 0, 2),)
+            spec0 = jnp.ones((b,), jnp.bool_)
+            (lengths, tokens, active, _, gen), outs = jax.lax.scan(
+                body, (lengths, tokens, active, spec0, gen), xs)
+            return (cache, lengths, tokens, active, gen) + tuple(outs)
+
+        exe = aot_compile(
+            verify,
+            (self.params, self._cache, self._len, self._tok, self._active,
+             self._gen, self._maxnew, self._temp, self._topk, self._seed,
+             jax.ShapeDtypeStruct((cfg.slots, w - 1), jnp.int32),
+             jax.ShapeDtypeStruct((cfg.slots,), jnp.int32)),
+            donate_argnums=(1,))
+        self._verify_exe[width] = exe
+        return exe
+
     def _get_resume_exe(self, rbucket: int):
         """Teacher-forced prompt-remainder ingest for a prefix-cache hit:
         consume the uncached prompt tail token by token through the
@@ -1115,3 +1378,5 @@ class ServingEngine:
         for b in (buckets or self.cfg.prompt_buckets):
             self._get_prefill_exe(self._bucket_for(b))
         self._get_decode_exe(self.cfg.decode_fuse)
+        if self._spec_capable and self.cfg.speculation > 0:
+            self._get_verify_exe(self.cfg.speculation + 1)

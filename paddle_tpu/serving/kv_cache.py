@@ -132,6 +132,38 @@ class PagedKVCache(_KVCacheBase):
         return attention_ops.decode_attention(q, ctx_k, ctx_v, ctx_len,
                                               sm_scale=sm_scale)
 
+    def decode_verify(self, state: Cache, layer: int, q, ctx_len,
+                      sm_scale: float = 1.0) -> jnp.ndarray:
+        """Speculative verify-window attention [B,W,H,D] over this layer's
+        ragged contexts (window position j = logical position ctx_len-1+j;
+        the caller wrote all W positions' K/V first). Rides the SAME ragged
+        Pallas kernel as ``decode_attention`` by flattening the window into
+        B*W pseudo-slots — each window row replays its slot's page table
+        with length ctx_len+j, which is exactly the per-slot raggedness the
+        kernel already handles; no kernel change, one dispatch. The XLA
+        gather + ops.attention_ops.verify_attention path stays the parity
+        reference (one ``context`` gather serves all W rows)."""
+        from ..ops import attention_ops
+
+        b, w = q.shape[0], q.shape[1]
+        mode = attention_ops.paged_kernel_mode()
+        if mode is not None:
+            from ..ops.pallas_kernels import paged_attention as _pa
+
+            if _pa.paged_attention_supported(self.dtype):
+                lens = ctx_len[:, None] + jnp.arange(w)[None, :]
+                lens = jnp.clip(lens.reshape(b * w), 0, self.max_ctx)
+                out = _pa.paged_decode_attention(
+                    q.reshape(b * w, self.n_head, self.d_head),
+                    state["k"][layer], state["v"][layer],
+                    jnp.repeat(state["pt"], w, axis=0), lens,
+                    page_size=self.page_size, sm_scale=sm_scale,
+                    interpret=(mode == "interpret"))
+                return out.reshape(b, w, self.n_head, self.d_head)
+        ctx_k, ctx_v = self.context(state, layer)
+        return attention_ops.verify_attention(q, ctx_k, ctx_v, ctx_len,
+                                              sm_scale=sm_scale)
+
     # -- prefill (one sequence) ----------------------------------------------
     def prompt_dest(self, pages) -> np.ndarray:
         """Host-side: the ``dest`` operand for ``write_prompt`` — a full
@@ -246,6 +278,16 @@ class Int8PagedKVCache(PagedKVCache):
         return attention_ops.decode_attention(q, ctx_k, ctx_v, ctx_len,
                                               sm_scale=sm_scale)
 
+    def decode_verify(self, state: Cache, layer: int, q, ctx_len,
+                      sm_scale: float = 1.0) -> jnp.ndarray:
+        """Gather-only, like ``decode_attention``: the ragged kernel has no
+        dequant stage, so int8 pools always dequantize through ``context``."""
+        from ..ops import attention_ops
+
+        ctx_k, ctx_v = self.context(state, layer)
+        return attention_ops.verify_attention(q, ctx_k, ctx_v, ctx_len,
+                                              sm_scale=sm_scale)
+
     def cache_bytes(self, state: Cache) -> int:
         return int(state["k"].nbytes + state["v"].nbytes
                    + state["ks"].nbytes + state["vs"].nbytes)
@@ -280,6 +322,14 @@ class ContiguousKVCache(_KVCacheBase):
 
         ctx_k, ctx_v = self.context(state, layer)
         return attention_ops.decode_attention(q, ctx_k, ctx_v, ctx_len,
+                                              sm_scale=sm_scale)
+
+    def decode_verify(self, state: Cache, layer: int, q, ctx_len,
+                      sm_scale: float = 1.0) -> jnp.ndarray:
+        from ..ops import attention_ops
+
+        ctx_k, ctx_v = self.context(state, layer)
+        return attention_ops.verify_attention(q, ctx_k, ctx_v, ctx_len,
                                               sm_scale=sm_scale)
 
     def prompt_dest(self, slot: int) -> np.int32:

@@ -61,18 +61,25 @@ class Request:
     None, so two requests never share one by accident); the stream is
     keyed by absolute context position, which makes replays reproducible
     across ``decode_fuse`` widths and slot re-admissions.
+
+    ``speculation`` overrides the engine's speculative-decoding default
+    for this request: ``None`` inherit, ``0`` off, a positive int the
+    draft k, ``"auto"`` the tune-table k (serving.speculative). A pure
+    scheduling knob — the emitted stream is bit-identical either way, so
+    replays (fleet requeues) need not pin it.
     """
 
     __slots__ = ("id", "prompt", "max_new_tokens", "state", "slot", "pages",
                  "tokens_out", "submitted_t", "admitted_t", "first_token_t",
                  "finished_t", "deadline_s", "error", "trace_id", "attempt",
-                 "temperature", "top_k", "seed")
+                 "temperature", "top_k", "seed", "speculation")
 
     def __init__(self, prompt: Sequence[int], max_new_tokens: int,
                  deadline_s: Optional[float] = None,
                  temperature: float = 0.0, top_k: int = 0,
                  seed: Optional[int] = None,
-                 trace_id: Optional[str] = None, attempt: int = 0):
+                 trace_id: Optional[str] = None, attempt: int = 0,
+                 speculation=None):
         if len(prompt) == 0:
             raise ValueError("Request needs a non-empty prompt")
         if max_new_tokens < 1:
@@ -109,6 +116,9 @@ class Request:
         # id-derived default: distinct per request, stable for replay when
         # the caller pins one explicitly
         self.seed = int(self.id if seed is None else seed) & 0x7FFFFFFF
+        from .speculative import parse_speculation
+
+        self.speculation = parse_speculation(speculation)
 
     @property
     def prompt_len(self) -> int:

@@ -50,7 +50,7 @@ __all__ = [
     "bucket_ctx",
     "table_path", "shipped_path", "entry_key",
     "lookup", "record", "read_entries", "write_entries",
-    "resolve_decode_fuse", "resolve_fleet_router",
+    "resolve_decode_fuse", "resolve_fleet_router", "resolve_speculation_k",
     "provenance_snapshot", "reset_provenance",
 ]
 
@@ -346,6 +346,24 @@ def resolve_decode_fuse(slots: int) -> Tuple[int, str]:
     except Exception:
         pass
     return 1, "default"
+
+
+def resolve_speculation_k(slots: int) -> Tuple[int, str]:
+    """(draft k, source) for speculative decoding on a serving engine with
+    ``slots`` batch slots — THE shared resolution
+    ``ServingConfig(speculation="auto")`` and ``tools/serve_bench`` both
+    use, mirroring :func:`resolve_decode_fuse`. The useful k trades
+    verify-window compute against acceptance decay, so it is measured per
+    (slot bucket, device kind) by ``tools/autotune.py --kernel
+    speculation_k``. (4, "default") on no entry or any table failure:
+    serving must come up even with a corrupt table."""
+    try:
+        cfg, src = lookup("serving.speculation_k", bucket_slots(slots))
+        if cfg and int(cfg.get("k", 0)) > 0:
+            return int(cfg["k"]), src
+    except Exception:
+        pass
+    return 4, "default"
 
 
 def resolve_fleet_router(cpus: Optional[int] = None

@@ -21,7 +21,9 @@ Registered here:
 * ``paged_attention`` — ``block_pages`` of the ragged paged-attention
   decode kernel (KV pages DMA'd per online-softmax wave);
 * ``serving.decode_fuse`` — how many serving decode steps fuse into one
-  dispatched scan (host dispatch overhead vs admission latency).
+  dispatched scan (host dispatch overhead vs admission latency);
+* ``serving.speculation_k`` — draft length of the speculative
+  draft-verify fast path (tokens-per-dispatch vs rejected-verify waste).
 
 On CPU every tunable still builds and times (Pallas interpret mode / XLA
 CPU) so CI exercises the full mechanism; TPU numbers land via the same CLI
@@ -557,6 +559,88 @@ class DecodeFuseTunable(Tunable):
             slots=shape["slots"], page_size=shape["page_size"],
             max_seq=shape["max_seq"],
             decode_fuse=int(config["decode_fuse"])))
+        eng.warmup()
+        self._open.append(eng)
+        stream = self._stream(shape)
+
+        def drain():
+            reqs = [eng.submit(p, m) for p, m in stream]
+            done = eng.run()
+            assert len(done) == len(reqs)
+            return len(done)
+
+        return drain, ()
+
+    def cleanup(self):
+        for eng in self._open:
+            try:
+                eng.close()
+            except Exception:
+                pass
+        self._open.clear()
+        self._models.clear()
+
+
+@register_tunable("serving.speculation_k")
+class SpeculationKTunable(Tunable):
+    """Draft length k of the speculative draft-verify fast path
+    (serving.speculative). Measured as end-to-end drain time of a fixed
+    repetitive request stream — longer drafts emit more tokens per verify
+    dispatch while acceptance holds, but every rejected tail is verify
+    compute thrown away, so the winner tracks the traffic's repetitiveness
+    and the device's marginal cost of a wider ragged window (near-free on
+    the memory-bound paged kernel, real on CPU). ``k=0`` (plain decode) is
+    in the space, so a stream speculation cannot help reports an honest
+    "leave it off"."""
+
+    kernel = "serving.speculation_k"
+
+    def __init__(self):
+        self._open: list = []
+        self._models: Dict[str, object] = {}
+
+    def default_shapes(self):
+        return [dict(slots=4, vocab=48, n_layer=2, d_model=32, n_head=2,
+                     max_seq=64, page_size=8, n_requests=8, max_new=24)]
+
+    def bucket(self, shape):
+        return _table.bucket_slots(shape["slots"])
+
+    def candidates(self, shape):
+        return [{"k": k} for k in (0, 2, 4, 8)
+                if k < shape.get("max_new", 8)]
+
+    def default_config(self, shape):
+        return {"k": 4}  # tune.resolve_speculation_k's untuned default
+
+    def _stream(self, shape):
+        import numpy as np
+
+        # repetitive prompts (repeated trigrams) — the traffic class the
+        # n-gram drafter serves; greedy tiny-model loops extend the pattern
+        rng = np.random.RandomState(int(shape.get("seed", 0)))
+        out = []
+        for _ in range(shape["n_requests"]):
+            motif = list(rng.randint(0, shape["vocab"], 3))
+            out.append((motif * 4, int(shape["max_new"])))
+        return out
+
+    def build(self, shape, config):
+        from .. import serving
+        from ..models import decoder_lm
+
+        mkey = repr(sorted(shape.items()))
+        model = self._models.get(mkey)
+        if model is None:
+            cfg = decoder_lm.DecoderConfig(
+                vocab_size=shape["vocab"], n_layer=shape["n_layer"],
+                d_model=shape["d_model"], n_head=shape["n_head"],
+                max_seq=shape["max_seq"])
+            model = decoder_lm.DecoderLM(cfg, seed=0)
+            self._models[mkey] = model
+        eng = serving.ServingEngine(model, serving.ServingConfig(
+            slots=shape["slots"], page_size=shape["page_size"],
+            max_seq=shape["max_seq"], speculation=int(config["k"])))
         eng.warmup()
         self._open.append(eng)
         stream = self._stream(shape)

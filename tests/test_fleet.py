@@ -283,6 +283,33 @@ class TestRouter:
 
         assert json.loads(json.dumps(d)) == d  # frame-protocol safe
 
+    def test_fleet_request_doc_carries_speculation(self):
+        """The per-request speculation override rides the wire frame —
+        parsed at the router (so a bad value fails at submit, not on a
+        replica), JSON-safe in every accepted form."""
+        import json
+
+        assert FleetRequest(8, [1, 2], 4,
+                            speculation="auto").doc()["speculation"] == "auto"
+        assert FleetRequest(9, [1], 4).doc()["speculation"] is None
+        assert FleetRequest(10, [1], 4,
+                            speculation="off").doc()["speculation"] == 0
+        d = FleetRequest(11, [1], 4, speculation=64).doc()
+        assert isinstance(d["speculation"], int)  # capped, still an int
+        assert json.loads(json.dumps(d)) == d
+        with pytest.raises(ValueError):
+            FleetRequest(12, [1], 4, speculation=-3)
+
+    def test_sim_replica_accepts_speculative_submits(self):
+        """Sim engines ignore speculation but must accept the doc field —
+        a fleet mixing sim and real replicas routes the same wire form to
+        both."""
+        router = _sim_router(n=1)
+        fr = router.submit([5, 5, 5], 4, speculation="auto")
+        assert router.wait_all(20.0)
+        assert fr.state == "finished" and len(fr.tokens) == 4
+        router.close()
+
 
 # -- telemetry aggregation ----------------------------------------------------
 class TestAggregateTelemetry:
@@ -482,6 +509,48 @@ class TestFleetTrace:
                    for d in digests.values())
         trace_ids = {f.trace_id for f in frs}
         assert set(digests) == trace_ids
+
+
+# -- speculative requests through the fleet (real engines) --------------------
+class TestFleetSpeculative:
+    @staticmethod
+    def _real_router(model, n=2):
+        from paddle_tpu import serving
+
+        def factory(i):
+            return serving.ServingEngine(model, serving.ServingConfig(
+                slots=2, page_size=8, max_seq=64))
+
+        return Router(FleetConfig(replicas=n, mode="inprocess",
+                                  affinity="round_robin",
+                                  engine_factory=factory))
+
+    def test_kill_replays_speculative_bit_identical(self, tiny_model):
+        """A speculative request stranded by a killed replica must
+        requeue and replay BIT-identically to an unkilled twin: greedy
+        draft-verify emits the same (seed, position)-keyed stream as
+        plain decode, so the fleet's replay invariant holds unchanged
+        even when the respawned replica re-runs the whole request."""
+        import numpy as np
+
+        rng = np.random.RandomState(5)
+        prompts = [list(rng.randint(0, 64, 3)) * 4 for _ in range(4)]
+        req0 = fm.REQUEUED.value
+        router = self._real_router(tiny_model)
+        frs = [router.submit(p, 6, speculation=4) for p in prompts]
+        for _ in range(2):
+            router.pump()
+        router._replicas[1].kill()
+        assert router.wait_all(120.0)
+        assert set(router.accounting().values()) == {"finished"}
+        assert fm.REQUEUED.value > req0, "the kill stranded nothing"
+        router.close()
+        twin = self._real_router(tiny_model, n=1)
+        frs_t = [twin.submit(p, 6, speculation=4) for p in prompts]
+        assert twin.wait_all(120.0)
+        twin.close()
+        assert [f.tokens for f in frs] == [f.tokens for f in frs_t], \
+            "a requeued speculative replay diverged from its unkilled twin"
 
 
 # -- engine-level prefix cache (real model) -----------------------------------

@@ -1,0 +1,424 @@
+"""Speculative decoding tests (ISSUE 17 tentpole coverage): the n-gram
+drafter's lookup rules, the speculation-knob grammar, the accept/reject
+residual-sampling identity, verify-window attention vs plain decode, and
+the engine-level invariants — greedy AND sampled speculative streams are
+bit-identical to plain decode, and rejected draft tokens never leak pages
+across any retirement path (finished, timeout, decode-failure, drain)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import serving
+from paddle_tpu.models import decoder_lm
+from paddle_tpu.ops import attention_ops
+from paddle_tpu.serving.speculative import (SPEC_K_CAP, NGramDrafter,
+                                            make_drafter, parse_speculation,
+                                            residual_sample)
+
+_MODEL = None
+
+
+def get_model():
+    """One tiny decoder shared across tests (init cost, not compile cost —
+    each engine still AOT-compiles its own step functions)."""
+    global _MODEL
+    if _MODEL is None:
+        cfg = decoder_lm.DecoderConfig(vocab_size=64, n_layer=2, d_model=32,
+                                       n_head=2, max_seq=64)
+        _MODEL = decoder_lm.DecoderLM(cfg, seed=0)
+    return _MODEL
+
+
+def small_config(**kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("prompt_buckets", (16,))
+    return serving.ServingConfig(**kw)
+
+
+def rep_prompts(rng, n=4, motif=3, reps=4, vocab=64):
+    """Repetitive prompts (a short motif repeated) — the n-gram drafter's
+    best case, so acceptance-dependent assertions aren't vacuous."""
+    return [list(rng.randint(0, vocab, motif)) * reps for _ in range(n)]
+
+
+def assert_balanced(eng, label):
+    assert eng.pool.num_used == 0, "%s leaked pages" % label
+    assert eng.page_accounting_ok(), label
+
+
+def spec_counters():
+    from paddle_tpu.monitor import metrics as mx
+    snap = mx.snapshot()
+    return {n: float(snap.get(n, {}).get("value", 0.0))
+            for n in ("serving/spec_proposed_tokens",
+                      "serving/spec_accepted_tokens",
+                      "serving/spec_rejected_tokens",
+                      "serving/decode_dispatches")}
+
+
+# -- n-gram drafter -----------------------------------------------------------
+
+class TestNGramDrafter:
+    def test_trailing_ngram_continuation(self):
+        d = NGramDrafter(max_n=3, min_n=1)
+        # suffix [1, 2] previously occurred at the start; propose what
+        # followed it there
+        assert d.propose([1, 2, 3, 4, 1, 2], 3) == [3, 4, 1]
+
+    def test_longest_ngram_wins(self):
+        d = NGramDrafter(max_n=3, min_n=1)
+        # the trailing TRIgram [1, 2, 3] matches at index 1 — its
+        # continuation (9) wins over any shorter-suffix match elsewhere
+        h = [5, 1, 2, 3, 9, 7, 1, 2, 3]
+        assert d.propose(h, 2)[:1] == [9]
+
+    def test_rightmost_prior_occurrence_wins(self):
+        d = NGramDrafter(max_n=2, min_n=2)
+        # suffix [1, 2] occurs at 0 (-> 7) and at 3 (-> 8): most recent wins
+        assert d.propose([1, 2, 7, 1, 2, 8, 1, 2], 1) == [8]
+
+    def test_no_match_and_degenerate_inputs_are_empty(self):
+        d = NGramDrafter()
+        assert d.propose([1, 2, 3, 4, 5, 6], 4) == []   # no repeated n-gram
+        assert d.propose([1, 2, 3, 4], 0) == []         # k == 0
+        assert d.propose([7], 4) == []                  # history too short
+        assert d.propose([], 4) == []
+
+    def test_draft_capped_at_k(self):
+        d = NGramDrafter(max_n=2, min_n=1)
+        h = [1, 2, 3, 4, 5, 6, 1, 2]
+        assert d.propose(h, 2) == [3, 4]
+        assert len(d.propose(h, 8)) <= 8
+
+    def test_factory_and_validation(self):
+        assert make_drafter("ngram").kind == "ngram"
+        with pytest.raises(ValueError):
+            make_drafter("oracle")
+        with pytest.raises(ValueError):
+            NGramDrafter(max_n=1, min_n=2)
+
+
+# -- knob grammar -------------------------------------------------------------
+
+def test_parse_speculation_grammar():
+    assert parse_speculation(None) is None
+    for off in ("", "0", "off", "none", "false", "no", 0):
+        assert parse_speculation(off) == 0
+    assert parse_speculation("auto") == "auto"
+    assert parse_speculation("AUTO") == "auto"
+    assert parse_speculation(3) == 3
+    assert parse_speculation("5") == 5
+    assert parse_speculation(64) == SPEC_K_CAP
+    with pytest.raises(ValueError):
+        parse_speculation(-1)
+    with pytest.raises(ValueError):
+        parse_speculation("-2")
+
+
+# -- residual sampling --------------------------------------------------------
+
+def test_residual_sample_marginal_is_exactly_target(rng):
+    """The Leviathan guarantee: draft from q, accept with min(1, p/q),
+    resample the residual on reject — the emitted marginal is p."""
+    v, n = 8, 30000
+    p = rng.dirichlet(np.ones(v))
+    q = rng.dirichlet(np.ones(v))
+    drafts = rng.choice(v, size=n, p=q)
+    u1, u2 = rng.rand(n), rng.rand(n)
+    toks = np.zeros(n, np.int64)
+    acc = np.zeros(n, bool)
+    for i in range(n):
+        toks[i], acc[i] = residual_sample(p, q, drafts[i], u1[i], u2[i])
+    assert acc.any() and (~acc).any(), "need both branches exercised"
+    # acceptance rate is sum_t min(p_t, q_t)
+    assert abs(acc.mean() - np.minimum(p, q).sum()) < 0.02
+    hist = np.bincount(toks, minlength=v) / n
+    assert np.max(np.abs(hist - p)) < 0.02
+
+def test_residual_sample_edge_cases():
+    p = np.array([0.5, 0.5, 0.0, 0.0])
+    q = np.array([0.0, 0.0, 0.5, 0.5])
+    # draft has q-mass zero -> must reject into the residual (= p here)
+    tok, acc = residual_sample(p, q, 0, 0.0, 0.6)
+    assert not acc and tok == 1
+    # q == p: acceptance is certain for any u_accept < 1
+    tok, acc = residual_sample(p, p, 1, 0.999, 0.0)
+    assert acc and tok == 1
+
+
+# -- verify-window attention --------------------------------------------------
+
+def test_verify_attention_w1_matches_decode_attention(rng):
+    b, l, h, d = 3, 12, 2, 8
+    q = rng.randn(b, 1, h, d).astype(np.float32)
+    ck = rng.randn(b, l, h, d).astype(np.float32)
+    cv = rng.randn(b, l, h, d).astype(np.float32)
+    ctx_len = np.array([4, 12, 7], np.int32)
+    got = np.asarray(attention_ops.verify_attention(q, ck, cv, ctx_len,
+                                                    sm_scale=0.5))
+    want = np.asarray(attention_ops.decode_attention(q[:, 0], ck, cv, ctx_len,
+                                                     sm_scale=0.5))
+    # same masking, same softmax, same neg_inf constant; XLA batches the
+    # window einsum differently, so equality is numerical, not bitwise —
+    # TOKEN bit-parity is the engine-level tests' job
+    np.testing.assert_allclose(got[:, 0], want, rtol=1e-6, atol=1e-6)
+
+
+def test_verify_attention_rows_are_causally_ragged(rng):
+    """Window row j attends to exactly ctx_len + j positions — i.e. each
+    row reproduces a plain decode step at its own logical position."""
+    b, l, h, d, w = 2, 16, 2, 8, 3
+    q = rng.randn(b, w, h, d).astype(np.float32)
+    ck = rng.randn(b, l, h, d).astype(np.float32)
+    cv = rng.randn(b, l, h, d).astype(np.float32)
+    ctx_len = np.array([5, 9], np.int32)
+    got = np.asarray(attention_ops.verify_attention(q, ck, cv, ctx_len))
+    for j in range(w):
+        row = np.asarray(attention_ops.decode_attention(
+            q[:, j], ck, cv, ctx_len + j))
+        np.testing.assert_allclose(got[:, j], row, rtol=1e-6, atol=1e-6)
+
+
+# -- engine: bit parity -------------------------------------------------------
+
+def _drive(stream, spec, cfg_kw=None, **submit_kw):
+    eng = serving.ServingEngine(get_model(), small_config(**(cfg_kw or {})))
+    reqs = [eng.submit(p, m, speculation=spec, **submit_kw)
+            for p, m in stream]
+    eng.run()
+    assert_balanced(eng, "spec=%r" % (spec,))
+    toks = [list(r.tokens_out) for r in reqs]
+    states = [r.state for r in reqs]
+    eng.close()
+    assert all(s == "finished" for s in states), states
+    return toks
+
+
+def test_greedy_speculative_bit_parity(rng):
+    stream = [(p, 12) for p in rep_prompts(rng, n=5)]
+    c0 = spec_counters()
+    spec = _drive(stream, 4)
+    c1 = spec_counters()
+    plain = _drive(stream, 0)
+    assert spec == plain, "speculative greedy stream diverged from decode"
+    accepted = c1["serving/spec_accepted_tokens"] - \
+        c0["serving/spec_accepted_tokens"]
+    proposed = c1["serving/spec_proposed_tokens"] - \
+        c0["serving/spec_proposed_tokens"]
+    assert accepted > 0, "repetitive stream accepted nothing — vacuous parity"
+    assert proposed >= accepted
+
+
+def test_speculation_saves_dispatches_on_repetitive_stream(rng):
+    stream = [(p, 14) for p in rep_prompts(rng, n=4)]
+    c0 = spec_counters()
+    _drive(stream, 4)
+    c1 = spec_counters()
+    _drive(stream, 0)
+    c2 = spec_counters()
+    d_spec = c1["serving/decode_dispatches"] - c0["serving/decode_dispatches"]
+    d_plain = c2["serving/decode_dispatches"] - c1["serving/decode_dispatches"]
+    assert d_spec < d_plain, \
+        "the whole point: fewer dispatches for the same tokens (%d vs %d)" \
+        % (d_spec, d_plain)
+
+
+def test_sampled_speculative_bit_parity(rng):
+    """The (seed, position)-keyed sampler makes even SAMPLED speculative
+    decode bit-identical to plain decode — stronger than the distribution
+    match the accept/reject math alone would promise."""
+    stream = [(p, 10) for p in rep_prompts(rng, n=4)]
+    for temp, top_k in ((0.8, 0), (1.2, 5)):
+        spec = _drive(stream, 4, temperature=temp, top_k=top_k, seed=17)
+        plain = _drive(stream, 0, temperature=temp, top_k=top_k, seed=17)
+        assert spec == plain, "sampled divergence at T=%s top_k=%d" \
+            % (temp, top_k)
+
+
+def test_sampled_speculative_histogram_matches_plain(rng):
+    """Belt and braces on top of bit-parity: the emitted token histogram
+    over many sampled requests is identical between the two paths."""
+    stream = [(p, 8) for p in rep_prompts(rng, n=6)]
+    spec = _drive(stream, 3, temperature=1.0, top_k=0, seed=5)
+    plain = _drive(stream, 0, temperature=1.0, top_k=0, seed=5)
+    h_spec = np.bincount(np.concatenate([np.asarray(t) for t in spec]),
+                         minlength=64)
+    h_plain = np.bincount(np.concatenate([np.asarray(t) for t in plain]),
+                          minlength=64)
+    assert np.array_equal(h_spec, h_plain)
+
+
+def test_mixed_speculation_per_request(rng):
+    """Speculating and non-speculating requests share ticks; each still
+    emits its own plain-decode stream."""
+    prompts = rep_prompts(rng, n=4)
+    eng = serving.ServingEngine(get_model(), small_config())
+    reqs = [eng.submit(p, 10, speculation=(4 if i % 2 == 0 else 0))
+            for i, p in enumerate(prompts)]
+    eng.run()
+    assert_balanced(eng, "mixed")
+    mixed = [list(r.tokens_out) for r in reqs]
+    eng.close()
+    assert mixed == _drive([(p, 10) for p in prompts], 0)
+
+
+def test_greedy_parity_includes_captured_logits(rng):
+    stream = [(p, 8) for p in rep_prompts(rng, n=3)]
+
+    def capture(spec):
+        eng = serving.ServingEngine(get_model(),
+                                    small_config(collect_logits=True))
+        reqs = [eng.submit(p, m, speculation=spec) for p, m in stream]
+        eng.run()
+        rows = [[np.asarray(x) for x in eng.captured_logits(r)]
+                for r in reqs]
+        toks = [list(r.tokens_out) for r in reqs]
+        eng.close()
+        return toks, rows
+
+    t_spec, l_spec = capture(4)
+    t_plain, l_plain = capture(0)
+    assert t_spec == t_plain
+    for rs, rp in zip(l_spec, l_plain):
+        assert len(rs) == len(rp)
+        for a, b in zip(rs, rp):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+# -- engine: page accounting across every retirement path ---------------------
+
+def test_spec_page_accounting_every_retirement_path(rng):
+    from paddle_tpu.reliability import FaultPlan, faults
+
+    prompts = rep_prompts(rng, n=3)
+
+    # 1. normal finish (covered again here so the four paths sit together)
+    eng = serving.ServingEngine(get_model(), small_config())
+    for p in prompts:
+        eng.submit(p, 8, speculation=4)
+    eng.run()
+    assert_balanced(eng, "finished")
+    eng.close()
+
+    # 2. deadline timeout mid-speculation: rejected draft tokens must not
+    # strand the pages the verify window touched
+    eng_t = serving.ServingEngine(get_model(), small_config())
+    r_dead = eng_t.submit(prompts[0], 32, deadline_s=0.0, speculation=4)
+    r_live = eng_t.submit(prompts[1], 4, speculation=4)
+    eng_t.run(max_steps=100)
+    assert r_dead.state == "timeout"
+    assert r_live.state == "finished"
+    assert_balanced(eng_t, "timeout")
+    eng_t.close()
+
+    # 3. injected decode failure: the failed request's pages come back
+    eng_f = serving.ServingEngine(get_model(),
+                                  small_config(decode_retries=0))
+    plan = FaultPlan([faults.FaultSpec("serving.decode", "fatal", at=1)])
+    with plan:
+        r_a = eng_f.submit(prompts[0], 6, speculation=4)
+        r_b = eng_f.submit(prompts[1], 6, speculation=4)
+        eng_f.run(max_steps=100)
+    assert r_a.state == "failed" and not r_a.pages
+    assert r_b.state in ("failed", "finished")
+    assert_balanced(eng_f, "decode-failure")
+    # engine survives for fresh speculative traffic
+    r_after = eng_f.submit(prompts[2], 4, speculation=4)
+    eng_f.run(max_steps=100)
+    assert r_after.state == "finished"
+    assert_balanced(eng_f, "post-failure")
+    eng_f.close()
+
+    # 4. drain with speculative requests still in flight
+    eng_d = serving.ServingEngine(get_model(), small_config())
+    for p in prompts:
+        eng_d.submit(p, 30, speculation=4)
+    eng_d.step()
+    eng_d.drain(timeout_s=0.0)
+    assert_balanced(eng_d, "drain")
+    eng_d.close()
+
+
+# -- engine: layout / kernel orthogonality ------------------------------------
+
+def test_int8_kv_speculative_matches_int8_plain(monkeypatch, tmp_path, rng):
+    """Speculation is orthogonal to KV quantization: int8+spec emits the
+    int8 plain-decode stream (compare like with like — int8 vs fp drift
+    is test_numerics' business, not ours)."""
+    from paddle_tpu.monitor import numerics as num
+
+    tbl = str(tmp_path / "calib.json")
+    monkeypatch.setenv("PADDLE_TPU_NUMERICS_TABLE", tbl)
+    mc = get_model().cfg
+    num.record_kv_calibration(
+        num.kv_fingerprint(mc.n_layer, mc.n_head, mc.d_head, mc.dtype),
+        4.0, 4.0, path=tbl)
+    stream = [(p, 8) for p in rep_prompts(rng, n=3)]
+
+    def drive_int8(spec):
+        eng = serving.ServingEngine(get_model(),
+                                    small_config(kv_dtype="int8"))
+        assert eng.cache_ops.layout == "paged-int8"
+        reqs = [eng.submit(p, m, speculation=spec) for p, m in stream]
+        eng.run()
+        assert_balanced(eng, "int8 spec=%r" % (spec,))
+        toks = [list(r.tokens_out) for r in reqs]
+        eng.close()
+        return toks
+
+    assert drive_int8(4) == drive_int8(0)
+
+
+def test_decode_verify_kernel_interpret_matches_gather(rng):
+    """The fused verify dispatch rides the paged kernel via B*W pseudo-slot
+    flattening; in interpret mode it must emit the gather path's stream."""
+    from paddle_tpu.flags import set_flag
+
+    stream = [(p, 10) for p in rep_prompts(rng, n=3)]
+
+    def drive_flag(mode):
+        set_flag("paged_attention_kernel", mode)
+        try:
+            return _drive(stream, 4)
+        finally:
+            set_flag("paged_attention_kernel", "auto")
+
+    assert drive_flag("interpret") == drive_flag("off")
+
+
+# -- engine: config + stats surface -------------------------------------------
+
+def test_speculation_info_and_stats_surface(rng):
+    eng = serving.ServingEngine(get_model(),
+                                small_config(speculation=3))
+    k, kind, src = eng.speculation_info()
+    assert (k, kind, src) == (3, "ngram", "explicit")
+    st = eng.stats()
+    assert st["speculation"] == 3
+    assert st["spec_drafter"] == "ngram"
+    assert st["speculation_source"] == "explicit"
+    eng.close()
+
+    eng_off = serving.ServingEngine(get_model(), small_config())
+    assert eng_off.speculation_info()[0] == 0
+    eng_off.close()
+
+    eng_auto = serving.ServingEngine(get_model(),
+                                     small_config(speculation="auto"))
+    k, kind, src = eng_auto.speculation_info()
+    assert k >= 1 and kind == "ngram"
+    assert src in ("tuned", "shipped", "default")
+    eng_auto.close()
+
+
+def test_bad_speculation_rejected_at_submit(rng):
+    eng = serving.ServingEngine(get_model(), small_config())
+    with pytest.raises(ValueError):
+        eng.submit([1, 2, 3], 4, speculation=-2)
+    with pytest.raises(ValueError):
+        eng.submit([1, 2, 3], 4, speculation="fast")
+    eng.run()
+    assert_balanced(eng, "rejected submits")
+    eng.close()

@@ -51,7 +51,7 @@ BUDGETS = {
     "sparse_adam": 15.0,
     "paged_attention": 15.0,
     "profile_report": 15.0,
-    "serve_bench": 45.0,
+    "serve_bench": 75.0,   # speculative leg + its repetitive-stream drill
     "fleet_bench": 30.0,
     "chaos_drill": 30.0,
     "fleet_trace": 10.0,

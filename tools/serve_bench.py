@@ -20,7 +20,10 @@
         Pallas kernel armed (interpret mode off-TPU — a parity/mechanism
         leg there, a perf leg on hardware) and reports the kernel:gather
         QPS + tokens/s ratios; ``auto`` (default) adds that leg only
-        where the kernel compiles (TPU).
+        where the kernel compiles (TPU). A ``continuous_paged_speculative``
+        leg always rides along: the same stream through the draft-verify
+        fast path (``speculation="auto"``), reporting acceptance rate and
+        tokens-per-dispatch next to its tokens/s.
 
 ``bench.py --serve`` imports :func:`serve_bench` from here, so the bench
 leg and the smoke share one driver.
@@ -45,6 +48,32 @@ from paddle_tpu.monitor.metrics import sorted_percentile  # noqa: E402
 # the engine currently being driven by drive() — what the SIGTERM handler
 # drains instead of letting the process die mid-decode
 _live_engine = [None]
+
+# counters drive() snapshots around each leg so the digest can report
+# per-leg speculative accounting (the registry is process-global)
+_LEG_COUNTERS = ("serving/spec_proposed_tokens",
+                 "serving/spec_accepted_tokens",
+                 "serving/decode_dispatches")
+
+# digest fields that ride into the run ledger although they are strings —
+# the per-leg provenance (which kernel / drafter / table layer ran)
+_PROVENANCE_KEYS = ("decode_kernel", "decode_kernel_source",
+                    "spec_drafter", "speculation_source")
+
+
+def _counter_values():
+    from paddle_tpu.monitor import metrics as mx
+
+    snap = mx.snapshot()
+    return {n: float(snap.get(n, {}).get("value", 0.0))
+            for n in _LEG_COUNTERS}
+
+
+def _ledger_fields(digest):
+    """The numeric fields of a leg digest plus its provenance strings —
+    what one run-ledger config record carries for that leg."""
+    return {k: v for k, v in digest.items()
+            if isinstance(v, (int, float)) or k in _PROVENANCE_KEYS}
 
 
 def _install_sigterm_drain() -> None:
@@ -87,6 +116,7 @@ def drive(model, stream, scfg, warmup=True, keep_open=False):
     _live_engine[0] = eng
     if warmup:
         eng.warmup()
+    c0 = _counter_values()
     t0 = time.perf_counter()
     reqs = []
     try:
@@ -110,10 +140,11 @@ def drive(model, stream, scfg, warmup=True, keep_open=False):
         eng.close()
     assert len(done) == len(reqs), "stream did not drain: %d/%d" % (
         len(done), len(reqs))
+    c1 = _counter_values()
     lat_ms = sorted(1e3 * r.latency_s for r in reqs)
     ttft_ms = sorted(1e3 * r.ttft_s for r in reqs)
     tokens = sum(len(r.tokens_out) for r in reqs)
-    return {
+    digest = {
         "mode": ("continuous" if scfg.continuous else "static_padded")
                 + "_" + eng.cache_ops.layout,
         "requests": len(reqs),
@@ -131,7 +162,26 @@ def drive(model, stream, scfg, warmup=True, keep_open=False):
         # default) — the per-kernel provenance the summary tail carries
         "decode_kernel": eng.stats()["decode_kernel"],
         "decode_kernel_source": eng.stats()["decode_kernel_source"],
-    }, eng
+    }
+    spec_k, spec_kind, spec_src = eng.speculation_info()
+    if spec_k > 0:
+        # the speculative leg's own accounting, from this leg's counter
+        # deltas: how many draft tokens the target accepted, and how many
+        # tokens each model dispatch retired on average (> 1.0 = the
+        # draft-verify window is paying for itself)
+        proposed = c1[_LEG_COUNTERS[0]] - c0[_LEG_COUNTERS[0]]
+        accepted = c1[_LEG_COUNTERS[1]] - c0[_LEG_COUNTERS[1]]
+        dispatches = c1[_LEG_COUNTERS[2]] - c0[_LEG_COUNTERS[2]]
+        digest.update({
+            "speculation": spec_k,
+            "spec_drafter": spec_kind,
+            "speculation_source": spec_src,
+            "spec_proposed": int(proposed),
+            "spec_accepted": int(accepted),
+            "acceptance_rate": round(accepted / max(1.0, proposed), 4),
+            "tokens_per_dispatch": round(tokens / max(1.0, dispatches), 3),
+        })
+    return digest, eng
 
 
 def resolve_decode_fuse(decode_fuse, slots):
@@ -277,6 +327,29 @@ def serve_bench(n_requests=64, slots=8, vocab=512, n_layer=4, d_model=128,
                     os.environ["PADDLE_TPU_NUMERICS_TABLE"] = prev_tbl
         except Exception as e:  # calibration leg must never sink the headline
             out["continuous_paged_int8_2x"] = {"error": repr(e)[:200]}
+        try:
+            # the speculative leg: the SAME greedy stream through the
+            # draft-verify fast path — a zero-weight n-gram drafter
+            # proposes k tokens per tick and the target verifies the
+            # whole window in ONE fused dispatch riding the same paged
+            # layout. k resolves through the tune table ("auto"), and the
+            # acceptance theorem makes the greedy stream bit-identical to
+            # plain decode, so token_parity is an invariant, not luck.
+            sp, _ = drive(model, stream, serving.ServingConfig(
+                slots=slots, page_size=page_size, max_seq=max_seq,
+                decode_fuse=decode_fuse, paged=True, continuous=True,
+                speculation="auto"))
+            sp["mode"] = "continuous_paged_speculative"
+            out["continuous_paged_speculative"] = sp
+            out["speculative_vs_plain"] = {
+                "token_parity": sp["tokens"] == ragged["tokens"],
+                "tokens_per_sec_ratio": round(
+                    sp["tokens_per_sec"] / ragged["tokens_per_sec"], 3),
+                "acceptance_rate": sp.get("acceptance_rate", 0.0),
+                "tokens_per_dispatch": sp.get("tokens_per_dispatch", 0.0),
+            }
+        except Exception as e:  # the spec leg must never sink the headline
+            out["continuous_paged_speculative"] = {"error": repr(e)[:200]}
     finally:
         set_flag("paged_attention_kernel", prev_kernel)
     # observability artifact pointers for the summary tail: with
@@ -528,6 +601,60 @@ def selftest() -> int:
     assert i8_bytes < fp_bytes, (i8_bytes, fp_bytes)
     eng_fp.close()
     eng_i8.close()
+    # --- speculative decoding: the draft-verify fast path ----------------
+    # the bench's own speculative leg first: it ran the SAME greedy
+    # stream, so the equivalence theorem (serving/speculative.py) makes
+    # token parity an invariant; the leg must also carry its provenance
+    # (drafter kind, k, which tune-table layer supplied it)
+    sleg = res["continuous_paged_speculative"]
+    assert "error" not in sleg, sleg
+    assert sleg["tokens"] == res["continuous_paged"]["tokens"], (
+        sleg["tokens"], res["continuous_paged"]["tokens"])
+    assert sleg["speculation"] >= 1 and sleg["spec_drafter"] == "ngram", sleg
+    assert sleg["speculation_source"] in ("tuned", "shipped", "default")
+    assert res["speculative_vs_plain"]["token_parity"], (
+        res["speculative_vs_plain"])
+    snap = mx.snapshot()
+    for name in ("serving/spec_proposed_tokens",
+                 "serving/spec_accepted_tokens",
+                 "serving/spec_rejected_tokens", "serving/spec_drafts",
+                 "serving/spec_verify_dispatches",
+                 "serving/spec_accept_rate"):
+        assert name in snap, "missing instrument %s" % name
+    # then the acceptance story on a stream built to accept: repetitive
+    # prompts the n-gram drafter predicts. Greedy speculative tokens must
+    # be BIT-identical to the plain-decode twin, acceptance must be
+    # positive, each verify dispatch must retire > 1 token on average,
+    # and page accounting must be exact after every rollback.
+    rep_rng = np.random.RandomState(11)
+    rep = [(list(rep_rng.randint(0, 64, 3)) * 4, 14) for _ in range(5)]
+    eng_plain = serving.ServingEngine(model, serving.ServingConfig(
+        slots=4, page_size=8, max_seq=64))
+    p_twins = [eng_plain.submit(p, m) for p, m in rep]
+    eng_plain.run()
+    c0 = mx.snapshot()
+    eng_spec = serving.ServingEngine(model, serving.ServingConfig(
+        slots=4, page_size=8, max_seq=64, speculation=4))
+    assert eng_spec.stats()["speculation"] == 4
+    assert eng_spec.stats()["speculation_source"] == "explicit"
+    s_twins = [eng_spec.submit(p, m) for p, m in rep]
+    eng_spec.run()
+    c1 = mx.snapshot()
+    for a, b in zip(p_twins, s_twins):
+        assert a.tokens_out == b.tokens_out, (a.tokens_out, b.tokens_out)
+    assert eng_spec.page_accounting_ok() and eng_spec.pool.num_used == 0
+    spec_prop = (c1["serving/spec_proposed_tokens"]["value"]
+                 - c0["serving/spec_proposed_tokens"]["value"])
+    spec_acc = (c1["serving/spec_accepted_tokens"]["value"]
+                - c0["serving/spec_accepted_tokens"]["value"])
+    spec_disp = (c1["serving/decode_dispatches"]["value"]
+                 - c0["serving/decode_dispatches"]["value"])
+    spec_toks = sum(len(r.tokens_out) for r in s_twins)
+    assert spec_acc > 0 and spec_prop >= spec_acc, (spec_acc, spec_prop)
+    spec_tpd = spec_toks / max(1.0, spec_disp)
+    assert spec_tpd > 1.0, (spec_toks, spec_disp)
+    eng_plain.close()
+    eng_spec.close()
     # --- run-ledger + perf-gate mechanics on a throwaway ledger ----------
     # both kernel variants land as configs in one serve_bench record, and
     # a steady ledger of them gates NEUTRAL/IMPROVED (never REGRESSED)
@@ -539,11 +666,11 @@ def selftest() -> int:
     prev_env = os.environ.get("PADDLE_TPU_RUN_LEDGER")
     os.environ["PADDLE_TPU_RUN_LEDGER"] = led
     try:
-        configs = {"serve_" + leg: {k: v for k, v in res[leg].items()
-                                    if isinstance(v, (int, float))}
+        configs = {"serve_" + leg: _ledger_fields(res[leg])
                    for leg in ("continuous_paged", "static_padded",
                                "continuous_paged_kernel",
-                               "continuous_paged_int8_2x")}
+                               "continuous_paged_int8_2x",
+                               "continuous_paged_speculative")}
         for _ in range(5):
             rec = runlog.record_run("serve_bench", configs)
         assert rec.get("ledger_path") == led, rec.get("ledger_path")
@@ -561,10 +688,14 @@ def selftest() -> int:
             os.environ["PADDLE_TPU_RUN_LEDGER"] = prev_env
     print("serve_bench selftest: OK (%.1fs)  %d requests traced; "
           "kernel leg %s/%s; int8 KV parity err %.2g with 2x pages "
-          "%dB <= fp %dB; trace: %s"
+          "%dB <= fp %dB; spec leg k=%d %s/%s accept %.0f/%.0f "
+          "(%.2f tok/dispatch, bit-parity); trace: %s"
           % (time.perf_counter() - t0, len(digests),
              kleg["decode_kernel"], kleg["decode_kernel_source"],
-             i8_err, i8_bytes, fp_bytes, trace_path))
+             i8_err, i8_bytes, fp_bytes,
+             sleg["speculation"], sleg["spec_drafter"],
+             sleg["speculation_source"], spec_acc, spec_prop, spec_tpd,
+             trace_path))
     return 0
 
 
@@ -601,11 +732,10 @@ def main(argv=None) -> int:
 
         configs = {}
         for leg in ("continuous_paged", "static_padded",
-                    "continuous_paged_kernel", "continuous_paged_int8_2x"):
+                    "continuous_paged_kernel", "continuous_paged_int8_2x",
+                    "continuous_paged_speculative"):
             if isinstance(res.get(leg), dict) and "error" not in res[leg]:
-                configs["serve_" + leg] = {
-                    k: v for k, v in res[leg].items()
-                    if isinstance(v, (int, float))}
+                configs["serve_" + leg] = _ledger_fields(res[leg])
         runlog.record_run("serve_bench", configs)
         res.update(runlog.tail_info())
     except Exception as e:
