@@ -19,6 +19,9 @@ __all__ = [
     "PREFIX_HITS", "PREFIX_MISSES", "PREFIX_INSERTS", "PREFIX_EVICTIONS",
     "PREFIX_ENTRIES", "PREFIX_PAGES", "PREFIX_TOKENS_REUSED",
     "PREFIX_POISONED_SKIPPED",
+    "MIGRATIONS_STARTED", "MIGRATIONS_COMPLETED", "MIGRATIONS_FAILED",
+    "MIGRATED_PAGES", "MIGRATION_MS",
+    "REMOTE_HITS", "REMOTE_MISSES", "REMOTE_SHIPS",
 ]
 
 SUBMITTED = _mx.counter(
@@ -90,3 +93,35 @@ PREFIX_POISONED_SKIPPED = _mx.counter(
     help="cacheable prefixes NOT inserted because their request did not "
          "FINISH (failed/timed-out pages are never served to a later "
          "request)")
+
+MIGRATIONS_STARTED = _mx.counter(
+    "fleet/migrations_started",
+    help="cross-replica KV-page migrations begun (disaggregated "
+         "prefill->decode handoff, fleet prefix-cache ship, rebalance, "
+         "scale-down)")
+MIGRATIONS_COMPLETED = _mx.counter(
+    "fleet/migrations_completed",
+    help="migrations whose pages landed on the destination replica")
+MIGRATIONS_FAILED = _mx.counter(
+    "fleet/migrations_failed",
+    help="migrations aborted (replica died / export miss / import "
+         "refused / timeout) — the carried request falls back to a cold "
+         "dispatch, never to a loss")
+MIGRATED_PAGES = _mx.counter(
+    "fleet/migrated_pages",
+    help="KV pages shipped across replicas over the binary page frame")
+MIGRATION_MS = _mx.histogram(
+    "fleet/migration_ms",
+    help="end-to-end migration latency (export op sent -> import ack)")
+
+REMOTE_HITS = _mx.counter(
+    "fleet/prefix_cache/remote_hits",
+    help="requests served on one replica from prefix pages prefilled on "
+         "ANOTHER (the fleet-wide prefix cache paid off)")
+REMOTE_MISSES = _mx.counter(
+    "fleet/prefix_cache/remote_misses",
+    help="fleet prefix-index probes whose owner could no longer produce "
+         "the entry (evicted/restarted) — the request prefills cold")
+REMOTE_SHIPS = _mx.counter(
+    "fleet/prefix_cache/remote_ships",
+    help="prefix entries shipped between replicas' prefix caches")

@@ -95,6 +95,29 @@ class PrefixCache:
         e = self._entries.get(prefix_key(tokens))
         return e is not None and e.tokens == tuple(int(t) for t in tokens)
 
+    def get(self, tokens: Sequence[int]) -> Optional[PrefixEntry]:
+        """Exact-match accessor (token-verified, no LRU refresh, no
+        hit/miss accounting) — the migration export path reads an entry
+        without pretending a request was served from it."""
+        e = self._entries.get(prefix_key(tokens))
+        if e is not None and e.tokens == tuple(int(t) for t in tokens):
+            return e
+        return None
+
+    def evict(self, tokens: Sequence[int]) -> List[int]:
+        """Drop one exact entry; returns its pages for the caller to free
+        ([] when absent). The rebalance path: ship a prefix to a peer,
+        then evict here — export + evict = move, and the freed pages are
+        the pool relief."""
+        e = self.get(tokens)
+        if e is None:
+            return []
+        del self._entries[e.key]
+        self.pages_held -= len(e.pages)
+        _fm.PREFIX_EVICTIONS.inc()
+        self._export_gauges()
+        return e.pages
+
     def lookup(self, prompt: Sequence[int]) -> Optional[PrefixEntry]:
         """Longest-match lookup for ``prompt``: probe page-aligned prefix
         lengths from the longest cacheable one down. A hit verifies token

@@ -1,11 +1,29 @@
-"""Length-prefixed JSON frames: the router <-> worker wire protocol.
+"""Length-prefixed frames: the router <-> worker wire protocol.
 
-One frame = a 4-byte big-endian length followed by that many bytes of
-UTF-8 JSON. Deliberately primitive — the protocol rides anonymous pipes
-(worker stdin/stdout), must survive a SIGKILLed peer mid-frame (the
-reader just sees a torn tail and EOF), and must be decodable by a human
-with ``xxd``. Router->worker ops and worker->router events are plain
-dicts; the op/event vocabulary lives in worker.py/replica.py, not here.
+One frame = a 4-byte big-endian length word followed by that many bytes
+of body. Two frame kinds share the stream, discriminated by the top bit
+of the length word:
+
+- **JSON frames** (top bit clear): UTF-8 JSON body — router->worker ops
+  and worker->router events as plain dicts. Deliberately primitive: the
+  protocol rides anonymous pipes (worker stdin/stdout), must survive a
+  SIGKILLed peer mid-frame (the reader just sees a torn tail and EOF),
+  and must be decodable by a human with ``xxd``. The op/event vocabulary
+  lives in worker.py/replica.py, not here.
+- **Binary frames** (top bit set): an opaque byte payload, delivered as
+  a :class:`Binary` wrapper. This is the KV-page migration bulk lane —
+  page bytes (and int8 pages with their per-page scales) must never
+  round-trip through JSON. Oversize and torn binary frames get exactly
+  the same typed treatment as JSON frames: a length over ``MAX_FRAME``
+  raises ``ValueError``, a torn body reads as peer-gone EOF (blocking
+  reader) or stays buffered until the next feed (incremental reader).
+
+:func:`pack_pages` / :func:`unpack_pages` define the page-payload body
+carried inside a binary frame: a small JSON meta header (layout,
+geometry, dtype, blob lengths) followed by the raw page blobs,
+concatenated. The meta dict doubles as the op/event envelope
+(``{"op": "import_prefix", ...}`` / ``{"ev": "pages", ...}``) so one
+binary frame is a complete, self-describing message.
 
 :class:`FrameReader` is the incremental decoder for the non-blocking
 side (the router tails N worker stdouts through a selector): ``feed()``
@@ -20,20 +38,49 @@ import errno
 import json
 import os
 import struct
-from typing import Any, Iterator, List, Optional
+from typing import Any, Iterator, List, Optional, Sequence, Tuple
 
-__all__ = ["MAX_FRAME", "send_frame", "read_frame", "FrameReader"]
+__all__ = ["MAX_FRAME", "Binary", "send_frame", "send_binary_frame",
+           "read_frame", "FrameReader", "pack_pages", "unpack_pages"]
 
 _HDR = struct.Struct(">I")
 MAX_FRAME = 32 << 20  # one generation result is KBs; 32MB = corrupt stream
+_BINARY_BIT = 0x80000000  # top bit of the length word marks a binary frame
+_LEN_MASK = _BINARY_BIT - 1
+
+
+class Binary:
+    """A received binary frame: ``payload`` is the raw body bytes. A
+    typed wrapper (not a bare ``bytes``) so dispatch loops can tell the
+    bulk lane from JSON dicts without sniffing."""
+
+    __slots__ = ("payload",)
+
+    def __init__(self, payload: bytes):
+        self.payload = payload
+
+    def __repr__(self) -> str:  # keep event-log reprs short
+        return "Binary(%d bytes)" % len(self.payload)
 
 
 def send_frame(fp, obj: Any) -> None:
-    """Serialize ``obj`` and write one frame to binary file object ``fp``
-    (flushes — a worker's result must not sit in userspace buffers while
-    the router waits on select)."""
+    """Serialize ``obj`` and write one JSON frame to binary file object
+    ``fp`` (flushes — a worker's result must not sit in userspace
+    buffers while the router waits on select)."""
     data = json.dumps(obj, separators=(",", ":")).encode("utf-8")
     fp.write(_HDR.pack(len(data)) + data)
+    fp.flush()
+
+
+def send_binary_frame(fp, payload: bytes) -> None:
+    """Write one binary frame. Refuses oversize payloads with the same
+    typed error the reader would raise — the sender fails fast instead
+    of poisoning the stream."""
+    n = len(payload)
+    if n > MAX_FRAME:
+        raise ValueError("frame length %d exceeds MAX_FRAME" % n)
+    fp.write(_HDR.pack(_BINARY_BIT | n))
+    fp.write(payload)
     fp.flush()
 
 
@@ -41,16 +88,21 @@ def read_frame(fp) -> Optional[Any]:
     """Blocking read of one frame from binary file object ``fp``; None on
     a clean EOF at a frame boundary. A torn frame (EOF mid-body — the
     peer died mid-write) also returns None: the caller treats both as
-    "peer gone", which is the only honest reading of either."""
+    "peer gone", which is the only honest reading of either. Binary
+    frames come back as :class:`Binary`."""
     hdr = fp.read(_HDR.size)
     if not hdr or len(hdr) < _HDR.size:
         return None
-    (n,) = _HDR.unpack(hdr)
+    (word,) = _HDR.unpack(hdr)
+    binary = bool(word & _BINARY_BIT)
+    n = word & _LEN_MASK
     if n > MAX_FRAME:
         raise ValueError("frame length %d exceeds MAX_FRAME" % n)
     body = fp.read(n)
     if body is None or len(body) < n:
         return None
+    if binary:
+        return Binary(body)
     return json.loads(body.decode("utf-8"))
 
 
@@ -84,19 +136,62 @@ class FrameReader:
         return total
 
     def frames(self) -> Iterator[Any]:
-        """Yield every complete frame currently buffered (a torn tail
-        stays buffered; after ``eof`` it is unrecoverable and ignored)."""
+        """Yield every complete frame currently buffered (a torn tail —
+        JSON or binary — stays buffered; after ``eof`` it is
+        unrecoverable and ignored). Binary frames yield :class:`Binary`."""
         while len(self._buf) >= _HDR.size:
-            (n,) = _HDR.unpack(bytes(self._buf[:_HDR.size]))
+            (word,) = _HDR.unpack(bytes(self._buf[:_HDR.size]))
+            binary = bool(word & _BINARY_BIT)
+            n = word & _LEN_MASK
             if n > MAX_FRAME:
                 raise ValueError("frame length %d exceeds MAX_FRAME" % n)
             if len(self._buf) < _HDR.size + n:
                 return
             body = bytes(self._buf[_HDR.size:_HDR.size + n])
             del self._buf[:_HDR.size + n]
-            yield json.loads(body.decode("utf-8"))
+            if binary:
+                yield Binary(body)
+            else:
+                yield json.loads(body.decode("utf-8"))
 
     def drain(self) -> List[Any]:
         """feed() + collect frames() — the router's per-tick pump."""
         self.feed()
         return list(self.frames())
+
+
+# -- page payloads ------------------------------------------------------------
+
+def pack_pages(meta: dict, blobs: Sequence[bytes]) -> bytes:
+    """Encode a page payload: 4-byte meta length + JSON meta (with
+    ``blob_lens`` recorded) + the raw blobs concatenated. The result is
+    the body of ONE binary frame — meta carries the op/event envelope so
+    the frame is self-describing."""
+    doc = dict(meta)
+    doc["blob_lens"] = [len(b) for b in blobs]
+    head = json.dumps(doc, separators=(",", ":")).encode("utf-8")
+    return b"".join([_HDR.pack(len(head)), head] + [bytes(b) for b in blobs])
+
+
+def unpack_pages(payload: bytes) -> Tuple[dict, List[bytes]]:
+    """Decode a :func:`pack_pages` payload into ``(meta, blobs)``. A
+    short/torn payload raises ``ValueError`` — inside an intact binary
+    frame the payload is structurally complete, so a mismatch means the
+    sender and receiver disagree on the format, never a slow pipe."""
+    if len(payload) < _HDR.size:
+        raise ValueError("torn page payload: %d bytes" % len(payload))
+    (hn,) = _HDR.unpack(payload[:_HDR.size])
+    if _HDR.size + hn > len(payload):
+        raise ValueError("torn page payload: meta %d > %d bytes"
+                         % (hn, len(payload)))
+    meta = json.loads(payload[_HDR.size:_HDR.size + hn].decode("utf-8"))
+    lens = [int(x) for x in meta.get("blob_lens", [])]
+    off = _HDR.size + hn
+    if off + sum(lens) != len(payload):
+        raise ValueError("torn page payload: blobs %d != %d bytes"
+                         % (sum(lens), len(payload) - off))
+    blobs: List[bytes] = []
+    for ln in lens:
+        blobs.append(payload[off:off + ln])
+        off += ln
+    return meta, blobs

@@ -44,7 +44,8 @@ from ..reliability import faults as _faults
 from ..serving import metrics as _sm
 from ..serving.request import (FAILED, FINISHED, REJECTED, BackpressureError,
                                DrainingError, Request)
-from .protocol import FrameReader, send_frame
+from .protocol import (Binary, FrameReader, pack_pages, send_binary_frame,
+                       send_frame, unpack_pages)
 
 __all__ = ["SimConfig", "SimEngine", "InProcessReplica", "ProcessReplica",
            "sim_token"]
@@ -63,16 +64,31 @@ def sim_token(seed: int, pos: int, vocab: int) -> int:
 
 
 class SimConfig:
-    """Geometry + the modeled device latency of one sim replica."""
+    """Geometry + the modeled device latency of one sim replica.
+
+    The prefill cost model (all default-off, so existing benches are
+    untouched): admission of a prompt blocks ``prefill_ms_per_token`` per
+    token NOT covered by a known prefix — prefill is compute-bound and
+    stalls the whole engine, exactly the contention continuous batching
+    suffers. ``interference`` multiplies that stall while any slot is
+    mid-decode (mixed prefill/decode batches thrash batch shapes and HBM
+    — the published motivation for prefill/decode disaggregation): a
+    replica doing ONLY prefill (or only decode) never pays it.
+    ``page_size`` is the prefix granularity for the migration surface."""
 
     def __init__(self, slots: int = 4, step_ms: float = 0.0,
                  vocab: int = 256, max_queue: int = 1024,
-                 drain_timeout_s: float = 30.0):
+                 drain_timeout_s: float = 30.0, page_size: int = 16,
+                 prefill_ms_per_token: float = 0.0,
+                 interference: float = 1.0):
         self.slots = int(slots)
         self.step_ms = float(step_ms)
         self.vocab = int(vocab)
         self.max_queue = int(max_queue)
         self.drain_timeout_s = float(drain_timeout_s)
+        self.page_size = max(1, int(page_size))
+        self.prefill_ms_per_token = float(prefill_ms_per_token)
+        self.interference = max(1.0, float(interference))
 
 
 class SimEngine:
@@ -91,6 +107,11 @@ class SimEngine:
         self.last_drain: Optional[dict] = None
         self.force_degraded = False  # tests flip this to exercise routing
         self.steps = 0
+        # known prefixes (token tuple -> True): the sim analog of the real
+        # engine's prefix cache — a covered prefix skips its prefill stall
+        self._prefixes: Dict[tuple, bool] = {}
+        self._prefills = 0
+        self._resumes = 0
 
     # -- the engine contract --------------------------------------------------
     def submit(self, prompt, max_new_tokens, deadline_s=None,
@@ -118,15 +139,52 @@ class SimEngine:
         pos = req.prompt_len - 1 + len(req.tokens_out)
         req.tokens_out.append(sim_token(req.seed, pos, self.cfg.vocab))
 
+    def _cacheable_len(self, n: int) -> int:
+        # same alignment rule as fleet.prefix_cache: longest page-aligned
+        # prefix STRICTLY shorter than the prompt
+        return ((int(n) - 1) // self.cfg.page_size) * self.cfg.page_size
+
+    def _known_prefix_len(self, prompt) -> int:
+        ps = self.cfg.page_size
+        prompt = [int(t) for t in prompt]
+        for n in range(self._cacheable_len(len(prompt)), 0, -ps):
+            if tuple(prompt[:n]) in self._prefixes:
+                return n
+        return 0
+
+    def _prefill_stall(self, req: Request) -> None:
+        """The modeled prefill cost of admitting ``req``: per uncovered
+        token, multiplied by ``interference`` when the stall lands in the
+        middle of live decodes (the mixed-batch penalty disaggregation
+        exists to remove)."""
+        if self.cfg.prefill_ms_per_token <= 0:
+            return
+        known = self._known_prefix_len(req.prompt)
+        if known:
+            self._resumes += 1
+        else:
+            self._prefills += 1
+        ms = (req.prompt_len - known) * self.cfg.prefill_ms_per_token
+        if any(len(r.tokens_out) < r.max_new_tokens for r in self._running):
+            ms *= self.cfg.interference
+        if ms > 0:
+            time.sleep(ms / 1e3)
+
     def step(self) -> List[Request]:
         """One sim cycle: admit into free slots (first token emitted at
-        admission, like prefill), block ``step_ms`` on the modeled device,
-        advance every running request one token."""
+        admission, like prefill — paying the modeled prefill stall first),
+        block ``step_ms`` on the modeled device, advance every running
+        request one token."""
         finished: List[Request] = []
         while self._queue and len(self._running) < self.cfg.slots:
             req = self._queue.pop(0)
             req.state = "running"
             req.admitted_t = time.perf_counter()
+            self._prefill_stall(req)
+            n = self._cacheable_len(req.prompt_len)
+            if n >= self.cfg.page_size:
+                # the sim donates at admission (prefilled rows exist now)
+                self._prefixes[tuple(int(t) for t in req.prompt[:n])] = True
             self._emit(req)
             req.first_token_t = time.perf_counter()
             self._running.append(req)
@@ -161,7 +219,39 @@ class SimEngine:
         return {"status": "degraded" if self.force_degraded else "ok",
                 "queued": len(self._queue), "running": len(self._running),
                 "consecutive_failures": 0, "faults_absorbed": 0,
-                "last_error": None, "page_accounting_ok": True}
+                "last_error": None, "page_accounting_ok": True,
+                "prefills": self._prefills, "resumes": self._resumes}
+
+    # -- migration surface (same duck type as ServingEngine) ------------------
+    def export_prefix_pages(self, tokens):
+        tokens = tuple(int(t) for t in tokens)
+        if tokens not in self._prefixes:
+            return None
+        return {"layout": "sim", "page_size": self.cfg.page_size,
+                "n_pages": len(tokens) // self.cfg.page_size}, []
+
+    def ingest_prefix_pages(self, tokens, meta: dict, blobs) -> bool:
+        if self._closed or meta.get("layout") != "sim":
+            return False  # a real-engine payload is not importable here
+        tokens = tuple(int(t) for t in tokens)
+        if not tokens or len(tokens) % self.cfg.page_size:
+            return False
+        self._prefixes[tokens] = True
+        return True
+
+    def evict_prefix(self, tokens) -> int:
+        if self._prefixes.pop(tuple(int(t) for t in tokens), None):
+            return max(1, len(tokens) // self.cfg.page_size)
+        return 0
+
+    def export_request_prefix(self, req: Request):
+        n = self._cacheable_len(req.prompt_len)
+        if n < self.cfg.page_size:
+            return None
+        tokens = [int(t) for t in req.prompt[:n]]
+        self._prefixes[tuple(tokens)] = True  # prefilled rows exist
+        return tokens, {"layout": "sim", "page_size": self.cfg.page_size,
+                        "n_pages": n // self.cfg.page_size}, []
 
     def request_drain(self) -> None:
         self._draining = True
@@ -214,6 +304,25 @@ def _engine_idle(engine) -> bool:
     return engine.scheduler.idle()
 
 
+def _decode_frames(frames) -> List[dict]:
+    """Normalize a frame batch: binary page frames unpack to their meta
+    dict with the blobs attached under ``"_blobs"`` (a foreign/garbled
+    payload is dropped — same tolerance as a torn JSON line in the event
+    log); JSON frames pass through."""
+    out: List[dict] = []
+    for fr in frames:
+        if isinstance(fr, Binary):
+            try:
+                meta, blobs = unpack_pages(fr.payload)
+            except ValueError:
+                continue
+            meta["_blobs"] = blobs
+            out.append(meta)
+        else:
+            out.append(fr)
+    return out
+
+
 class InProcessReplica:
     """A replica living in the router's process. ``poll()`` pumps the
     engine one step when it has work — the router's pump loop IS the
@@ -225,6 +334,7 @@ class InProcessReplica:
         self.engine = engine
         self.index = int(index)
         self.name = "replica-%d" % self.index
+        self.role = "uniform"  # the router stamps prefill/decode roles
         self.accepting = True
         self.alive = True
         self.inflight: Dict[int, dict] = {}   # fleet id -> request doc
@@ -308,6 +418,67 @@ class InProcessReplica:
         self.alive = False  # a drained engine is closed; respawn to reuse
         return summary
 
+    # -- migration ops (answers surface as events, like the wire mode) --------
+    def request_export_prefix(self, xid: int, tokens) -> None:
+        res = None
+        if self.alive and hasattr(self.engine, "export_prefix_pages"):
+            try:
+                res = self.engine.export_prefix_pages(tokens)
+            except ValueError:
+                res = None  # layout refuses pages: an honest export miss
+        if res is None:
+            self._events.append({"ev": "pages", "xid": xid, "ok": False})
+            return
+        meta, blobs = res
+        head = dict(meta, ev="pages", xid=xid, ok=True,
+                    tokens=[int(t) for t in tokens])
+        # round-trip the wire encoding even in-process, so every mode
+        # exercises the same serialization the binary frame carries
+        meta2, blobs2 = unpack_pages(pack_pages(head, blobs))
+        meta2["_blobs"] = blobs2
+        self._events.append(meta2)
+
+    def request_export_request(self, xid: int, fid: int) -> None:
+        res = None
+        rid = next((r for r, f in self._by_req.items() if f == fid), None)
+        req = self._requests.get(rid) if rid is not None else None
+        if self.alive and req is not None \
+                and hasattr(self.engine, "export_request_prefix"):
+            try:
+                res = self.engine.export_request_prefix(req)
+            except ValueError:
+                res = None
+        if res is None:
+            self._events.append({"ev": "pages", "xid": xid, "ok": False})
+            return
+        tokens, meta, blobs = res
+        head = dict(meta, ev="pages", xid=xid, ok=True, tokens=tokens)
+        meta2, blobs2 = unpack_pages(pack_pages(head, blobs))
+        meta2["_blobs"] = blobs2
+        self._events.append(meta2)
+
+    def request_import_prefix(self, xid: int, tokens, meta: dict,
+                              blobs) -> None:
+        ok = False
+        if self.alive and hasattr(self.engine, "ingest_prefix_pages"):
+            try:
+                ok = bool(self.engine.ingest_prefix_pages(tokens, meta,
+                                                          blobs))
+            except Exception:
+                ok = False
+        self._events.append(
+            {"ev": "imported", "xid": xid, "ok": ok,
+             "pages": int(meta.get("n_pages", 0)) if ok else 0})
+
+    def request_evict_prefix(self, xid: int, tokens) -> None:
+        n = 0
+        if self.alive and hasattr(self.engine, "evict_prefix"):
+            try:
+                n = int(self.engine.evict_prefix(tokens))
+            except Exception:
+                n = 0
+        self._events.append({"ev": "evicted", "xid": xid, "pages": n})
+
     def kill(self) -> None:
         """The in-process analog of SIGKILL: the engine vanishes with its
         in-flight work. ``inflight`` keeps the lost request docs for the
@@ -344,6 +515,7 @@ class ProcessReplica:
         self.spec = dict(spec)
         self.index = int(index)
         self.name = "replica-%d" % self.index
+        self.role = "uniform"  # the router stamps prefill/decode roles
         self.accepting = True
         self.inflight: Dict[int, dict] = {}
         self._events: List[dict] = []
@@ -379,10 +551,13 @@ class ProcessReplica:
         self._wait_ready(ready_timeout_s)
         self._clock_sync()
 
+    def _drain_frames(self) -> List[dict]:
+        return _decode_frames(self.reader.drain())
+
     def _wait_ready(self, timeout_s: float) -> None:
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
-            for ev in self.reader.drain():
+            for ev in self._drain_frames():
                 if ev.get("ev") == "ready":
                     self.pid = ev.get("pid")
                     return
@@ -414,7 +589,7 @@ class ProcessReplica:
             t1 = t0
             deadline = time.monotonic() + timeout_s
             while time.monotonic() < deadline:
-                evs = self.reader.drain()
+                evs = self._drain_frames()
                 t1 = _tracer.now_us()
                 for ev in evs:
                     if ev.get("ev") == "clock" and reply is None:
@@ -458,7 +633,7 @@ class ProcessReplica:
         evs, self._events = self._events, []  # drain events outlive alive
         if self._dead:
             return evs
-        evs.extend(self.reader.drain())
+        evs.extend(self._drain_frames())
         for ev in evs:
             if ev.get("ev") == "result":
                 self.inflight.pop(ev.get("id"), None)
@@ -479,6 +654,35 @@ class ProcessReplica:
         self._send({"op": "health"})
         return {}
 
+    # -- migration ops: answers arrive as pages/imported/evicted events -------
+    def request_export_prefix(self, xid: int, tokens) -> None:
+        self._send({"op": "export_prefix", "xid": xid,
+                    "tokens": [int(t) for t in tokens]})
+
+    def request_export_request(self, xid: int, fid: int) -> None:
+        self._send({"op": "export_request", "xid": xid, "id": fid})
+
+    def request_import_prefix(self, xid: int, tokens, meta: dict,
+                              blobs) -> None:
+        head = {k: v for k, v in meta.items() if k != "_blobs"}
+        head.update(op="import_prefix", xid=xid,
+                    tokens=[int(t) for t in tokens])
+        if self._dead:
+            return
+        try:
+            send_binary_frame(self.proc.stdin, pack_pages(head, blobs))
+        except (BrokenPipeError, OSError):
+            pass  # poll() observes the death; the migration times out
+        except ValueError:
+            # oversize payload: the import can never be delivered —
+            # synthesize the refusal so the router falls back immediately
+            self._events.append({"ev": "imported", "xid": xid,
+                                 "ok": False, "pages": 0})
+
+    def request_evict_prefix(self, xid: int, tokens) -> None:
+        self._send({"op": "evict_prefix", "xid": xid,
+                    "tokens": [int(t) for t in tokens]})
+
     def drain(self, timeout_s: Optional[float] = None) -> dict:
         """Graceful stop: the worker drains its engine, reports every
         tracked request's terminal state, emits ``drained`` and exits.
@@ -489,7 +693,7 @@ class ProcessReplica:
         summary: dict = {}
         deadline = time.monotonic() + (timeout_s or 30.0) + 10.0
         while time.monotonic() < deadline:
-            for ev in self.reader.drain():
+            for ev in self._drain_frames():
                 if ev.get("ev") == "drained":
                     summary = ev.get("summary", {})
                 else:

@@ -12,6 +12,11 @@ ops (router -> worker)::
      "deadline_s": d, "speculation": None|0|k|"auto"}
     {"op": "health"}                         # answered by a health event
     {"op": "clock"}                          # answered by a clock event
+    {"op": "export_prefix", "xid", "tokens"}   # -> pages (binary) | miss
+    {"op": "export_request", "xid", "id"}      # -> pages (binary) | miss
+    {"op": "evict_prefix", "xid", "tokens"}    # -> evicted
+    <binary frame: pack_pages({"op": "import_prefix", "xid", "tokens",
+     ...geometry...}, blobs)>                  # -> imported
     {"op": "drain", "timeout_s": t}          # graceful stop, then exit
     {"op": "shutdown"}                       # immediate close, then exit
 
@@ -21,6 +26,11 @@ events (worker -> router)::
     {"ev": "clock", "t_us": ...}             # tracer.now_us() snapshot
     {"ev": "result", "id", "state", "tokens", "error"[, "kind"]}
     {"ev": "health", "health": {...}}
+    <binary frame: pack_pages({"ev": "pages", "xid", "ok": true, "tokens",
+     ...geometry...}, blobs)>                # a KV-page export answer
+    {"ev": "pages", "xid", "ok": false}      # export miss/refusal
+    {"ev": "imported", "xid", "ok", "pages"} # import ack
+    {"ev": "evicted", "xid", "pages"}        # evict ack
     {"ev": "drained", "summary": {...}}      # last frame before exit
 
 Tracing: submits carry the fleet ``trace_id`` + ``attempt``, threaded
@@ -63,7 +73,8 @@ from ..monitor import tracer as _tracer
 from ..serving.request import (FAILED, REJECTED, BackpressureError,
                                DrainingError, Request)
 from . import trace as _ftrace
-from .protocol import FrameReader, send_frame
+from .protocol import (Binary, FrameReader, pack_pages, send_binary_frame,
+                       send_frame, unpack_pages)
 
 __all__ = ["main"]
 
@@ -141,6 +152,64 @@ class _Worker:
         for req in self.engine.step():
             self._result(req)
 
+    # -- KV-page migration ops ------------------------------------------------
+    # export answers ride ONE binary frame (meta envelope + raw page
+    # blobs, see protocol.pack_pages); misses and import acks are plain
+    # JSON events. Engines without the migration surface (or layouts
+    # without pages) answer honest misses/refusals, never crash.
+    def _emit_pages(self, xid, res, tokens=None) -> None:
+        if res is None:
+            self.emit({"ev": "pages", "xid": xid, "ok": False})
+            return
+        if tokens is None:
+            tokens, meta, blobs = res
+        else:
+            meta, blobs = res
+        head = dict(meta, ev="pages", xid=xid, ok=True,
+                    tokens=[int(t) for t in tokens])
+        send_binary_frame(self.chan, pack_pages(head, blobs))
+
+    def export_prefix(self, op: dict) -> None:
+        res = None
+        if hasattr(self.engine, "export_prefix_pages"):
+            try:
+                res = self.engine.export_prefix_pages(op.get("tokens") or [])
+            except ValueError:
+                res = None
+        self._emit_pages(op.get("xid"), res, tokens=op.get("tokens") or [])
+
+    def export_request(self, op: dict) -> None:
+        res = None
+        fid = op.get("id")
+        rid = next((r for r, f in self._by_req.items() if f == fid), None)
+        req = self._requests.get(rid) if rid is not None else None
+        if req is not None and hasattr(self.engine, "export_request_prefix"):
+            try:
+                res = self.engine.export_request_prefix(req)
+            except ValueError:
+                res = None
+        self._emit_pages(op.get("xid"), res)
+
+    def import_prefix(self, meta: dict, blobs) -> None:
+        ok = False
+        if hasattr(self.engine, "ingest_prefix_pages"):
+            try:
+                ok = bool(self.engine.ingest_prefix_pages(
+                    meta.get("tokens") or [], meta, blobs))
+            except Exception:
+                ok = False
+        self.emit({"ev": "imported", "xid": meta.get("xid"), "ok": ok,
+                   "pages": int(meta.get("n_pages", 0)) if ok else 0})
+
+    def evict_prefix(self, op: dict) -> None:
+        n = 0
+        if hasattr(self.engine, "evict_prefix"):
+            try:
+                n = int(self.engine.evict_prefix(op.get("tokens") or []))
+            except Exception:
+                n = 0
+        self.emit({"ev": "evicted", "xid": op.get("xid"), "pages": n})
+
     def busy(self) -> bool:
         if hasattr(self.engine, "idle"):
             return not self.engine.idle()
@@ -184,6 +253,8 @@ def main() -> int:
     while spec is None and time.monotonic() < deadline:
         select.select([stdin_fd], [], [], 1.0)
         for frame in reader.drain():
+            if isinstance(frame, Binary):
+                continue
             if frame.get("op") == "spec":
                 spec = frame.get("spec", {})
                 break
@@ -214,9 +285,24 @@ def main() -> int:
             timeout = 0.0 if worker.busy() else 0.05
             select.select([stdin_fd], [], [], timeout)
             for op in reader.drain():
+                if isinstance(op, Binary):
+                    # the bulk lane: one self-describing page payload
+                    try:
+                        meta, blobs = unpack_pages(op.payload)
+                    except ValueError:
+                        continue  # foreign/garbled payload: drop
+                    if meta.get("op") == "import_prefix":
+                        worker.import_prefix(meta, blobs)
+                    continue
                 kind = op.get("op")
                 if kind == "submit":
                     worker.submit(op)
+                elif kind == "export_prefix":
+                    worker.export_prefix(op)
+                elif kind == "export_request":
+                    worker.export_request(op)
+                elif kind == "evict_prefix":
+                    worker.evict_prefix(op)
                 elif kind == "health":
                     worker.emit({"ev": "health",
                                  "health": worker.engine.health()})

@@ -57,11 +57,12 @@ _MAD_SIGMA = 1.4826
 # name fragments decide which way "worse" points; checked lower-better
 # first so "latency_p50_ms" never reads as throughput
 _LOWER_BETTER = ("latency", "_ms", "ms_", "p99", "p95", "p50", "step_time",
-                 "wall", "overhead", "wait", "stall", "ttft")
+                 "wall", "overhead", "wait", "stall", "ttft",
+                 "migrated_pages")
 _HIGHER_BETTER = ("eps", "examples_per_sec", "steps_per_sec", "qps", "mfu",
                   "tokens_per_sec", "throughput", "efficiency", "speedup",
                   "ratio", "acceptance_rate", "accept_", "hit_rate",
-                  "per_dispatch")
+                  "remote_hit", "per_dispatch")
 
 
 def metric_direction(name: str) -> int:

@@ -321,6 +321,10 @@ class ServingEngine:
         self._captured_logits: Dict[int, List[np.ndarray]] = {}
         self._consecutive_failures = 0
         self._faults_absorbed = 0
+        # per-ENGINE prefill accounting (the registry counters are shared
+        # process-wide; a fleet replica's health doc needs its own)
+        self._prefills = 0
+        self._resumes = 0
         self._last_error: Optional[str] = None
         self._closed = False
         self._draining = False
@@ -638,6 +642,10 @@ class ServingEngine:
             "faults_absorbed": self._faults_absorbed,
             "last_error": self._last_error,
             "page_accounting_ok": self.page_accounting_ok(),
+            # full prefills vs prefix-resume ingests, THIS engine only —
+            # what a router reads to prove a migrated prefix skipped work
+            "prefills": self._prefills,
+            "resumes": self._resumes,
         }
         if self._slo_breach is not None:
             out["slo_breach"] = self._slo_breach.to_doc()
@@ -657,6 +665,85 @@ class ServingEngine:
         if self.prefix_cache is not None:
             held += self.prefix_cache.pages_held
         return self.pool.num_used == held
+
+    # -- cross-replica page migration -----------------------------------------
+    # The shippable unit of state is a prefix-cache entry: page-aligned
+    # prompt KV pages + the exact tokens they cover. Export COPIES bytes
+    # (ownership never crosses a process boundary); import is atomic from
+    # the pool's point of view — alloc, write, insert, and any failure
+    # frees the reservation before returning, so ``page_accounting_ok``
+    # holds on both sides of every migration outcome.
+    def export_prefix_pages(self, tokens: Sequence[int]):
+        """Serialize the prefix-cache entry exactly covering ``tokens`` to
+        ``(meta, blobs)``; None when absent (evicted, never donated) or
+        when this engine has no page concept (contiguous layout)."""
+        if self.prefix_cache is None:
+            return None
+        entry = self.prefix_cache.get(tokens)
+        if entry is None:
+            return None
+        return self.cache_ops.export_pages(self._cache, entry.pages)
+
+    def ingest_prefix_pages(self, tokens: Sequence[int], meta: dict,
+                            blobs) -> bool:
+        """Land an exported prefix into THIS engine's pool + prefix cache.
+        Returns False (never raises) when it cannot: no paged pool, no
+        prefix cache, geometry mismatch, pool exhausted, or the cache
+        refuses the insert — in every refusal the reservation is freed
+        first. Re-ingesting an already-held prefix is a no-op success."""
+        if self.pool is None or self.prefix_cache is None or self._closed:
+            return False
+        tokens = [int(t) for t in tokens]
+        n = int(meta.get("n_pages", 0))
+        if n < 1 or len(tokens) != n * self.cfg.page_size:
+            return False
+        if self.prefix_cache.contains(tokens):
+            return True
+        try:
+            pages = self.pool.alloc(n)
+        except PagePoolExhausted:
+            return False
+        try:
+            self._cache = self.cache_ops.import_pages(
+                self._cache, pages, meta, blobs)
+        except ValueError:
+            self.pool.free(pages)
+            return False
+        accepted, evicted = self.prefix_cache.insert(tokens, pages)
+        if evicted:
+            self.pool.free(evicted)
+        if not accepted:
+            self.pool.free(pages)
+            return False
+        return True
+
+    def evict_prefix(self, tokens: Sequence[int]) -> int:
+        """Drop one prefix entry and free its pages; returns pages freed.
+        With :meth:`export_prefix_pages` on the other side this is the
+        MOVE half of a rebalance: ship, then evict on the source."""
+        if self.pool is None or self.prefix_cache is None:
+            return 0
+        pages = self.prefix_cache.evict(tokens)
+        if pages:
+            self.pool.free(pages)
+        return len(pages)
+
+    def export_request_prefix(self, req: Request):
+        """Copy (never move) a live request's page-aligned PROMPT prefix —
+        those rows are immutable once prefilled, whatever decode is doing
+        — as ``(tokens, meta, blobs)``; None when there is less than one
+        full page or no paged pool. The scale-down path ships these so a
+        requeued request resumes from its prefill instead of redoing it."""
+        if self.pool is None or not req.pages:
+            return None
+        ps = self.cfg.page_size
+        n_tok = ((req.prompt_len - 1) // ps) * ps
+        npages = n_tok // ps
+        if npages < 1 or len(req.pages) < npages:
+            return None
+        meta, blobs = self.cache_ops.export_pages(
+            self._cache, req.pages[:npages])
+        return [int(t) for t in req.prompt[:n_tok]], meta, blobs
 
     # -- admission + prefill --------------------------------------------------
     def _bucket_for(self, n: int) -> int:
@@ -742,6 +829,7 @@ class ServingEngine:
         _trace.on_prefill(req, slot, bucket, t0, t1)
         _sm.PREFILL_MS.observe((t1 - t0) * 1e3)
         _sm.PREFILL_COUNT.inc()
+        self._prefills += 1
         return self._finish_prefill(req, slot, tok, last_logits)
 
     def _prefill_from_prefix(self, req: Request, slot: int, entry
@@ -786,6 +874,7 @@ class ServingEngine:
         _sm.PREFILL_MS.observe((t1 - t0) * 1e3)
         # deliberately NOT PREFILL_COUNT: the bench's "reduced prefill
         # dispatches vs cold" assertion reads that counter
+        self._resumes += 1
         return self._finish_prefill(req, slot, tok, last_logits)
 
     def _finish_prefill(self, req: Request, slot: int, tok: int,

@@ -36,6 +36,17 @@ __all__ = ["PagedKVCache", "Int8PagedKVCache", "ContiguousKVCache"]
 Cache = Dict[str, jnp.ndarray]
 
 
+def _dtype_by_name(name: str) -> np.dtype:
+    """Resolve a dtype by its ``.name`` — including the ml_dtypes extended
+    set (bfloat16 etc.) that ``np.dtype(str)`` does not know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 class _KVCacheBase:
     """Shared geometry: ``max_ctx`` context positions per slot, over
     ``n_layer`` layers of ``n_head`` heads of ``d_head`` lanes."""
@@ -53,6 +64,17 @@ class _KVCacheBase:
 
     def cache_bytes(self, state: Cache) -> int:
         return int(state["k"].nbytes + state["v"].nbytes)
+
+    # -- page migration ------------------------------------------------------
+    # Only paged layouts can ship pages; the dense layout refuses with a
+    # typed error (there IS no page — a contiguous slot's KV is not an
+    # addressable unit of state), which callers surface as "migration
+    # unsupported" rather than a crash.
+    def export_pages(self, state: Cache, pages):
+        raise ValueError("layout %r has no pages to export" % self.layout)
+
+    def import_pages(self, state: Cache, pages, meta: dict, blobs):
+        raise ValueError("layout %r has no pages to import" % self.layout)
 
 
 class PagedKVCache(_KVCacheBase):
@@ -188,6 +210,69 @@ class PagedKVCache(_KVCacheBase):
             "v": state["v"].at[layer, flat].set(v_new, mode="drop"),
         }
 
+    # -- page migration ------------------------------------------------------
+    def _page_rows(self, pages) -> np.ndarray:
+        p = np.asarray(pages, np.int64)
+        return (p[:, None] * self.page_size
+                + np.arange(self.page_size)[None, :]).reshape(-1)
+
+    def page_meta(self) -> dict:
+        """Geometry a page payload must match to be importable here —
+        embedded in every export, checked on every import."""
+        return {"layout": self.layout, "n_layer": self.n_layer,
+                "n_head": self.n_head, "d_head": self.d_head,
+                "page_size": self.page_size,
+                "kv_dtype": jnp.dtype(self._storage_dtype()).name}
+
+    def _storage_dtype(self):
+        return self.dtype
+
+    def _check_meta(self, meta: dict, n_blobs: int, blobs) -> None:
+        want = self.page_meta()
+        got = {k: meta.get(k) for k in want}
+        if got != want:
+            raise ValueError("page payload geometry mismatch: %r != %r"
+                             % (got, want))
+        if len(blobs) != n_blobs:
+            raise ValueError("page payload has %d blobs, expected %d"
+                             % (len(blobs), n_blobs))
+
+    def export_pages(self, state: Cache, pages):
+        """Serialize ``pages`` (pool page ids) to ``(meta, blobs)``: raw
+        C-order bytes of the K rows then the V rows, ``[n_layer,
+        n_pages*page_size, H, D]`` each — bit-exact, no float formatting."""
+        rows = self._page_rows(pages)
+        k = np.ascontiguousarray(np.asarray(state["k"][:, rows]))
+        v = np.ascontiguousarray(np.asarray(state["v"][:, rows]))
+        meta = self.page_meta()
+        meta["n_pages"] = len(pages)
+        return meta, [k.tobytes(), v.tobytes()]
+
+    def import_pages(self, state: Cache, pages, meta: dict, blobs) -> Cache:
+        """Write an exported payload into ``pages`` of THIS pool; raises
+        ``ValueError`` (typed, caller frees its reservation) on any
+        geometry/dtype/size mismatch. Row bytes land verbatim, so an
+        export of the same pages round-trips bit-identical."""
+        self._check_meta(meta, 2, blobs)
+        n = int(meta.get("n_pages", -1))
+        if n != len(pages):
+            raise ValueError("page payload has %d pages, caller reserved %d"
+                             % (n, len(pages)))
+        rows = self._page_rows(pages)
+        dt = _dtype_by_name(meta["kv_dtype"])
+        shp = (self.n_layer, len(rows), self.n_head, self.d_head)
+        want = int(np.prod(shp)) * dt.itemsize
+        if len(blobs[0]) != want or len(blobs[1]) != want:
+            raise ValueError("page payload blob bytes %d/%d != %d"
+                             % (len(blobs[0]), len(blobs[1]), want))
+        k = np.frombuffer(blobs[0], dtype=dt).reshape(shp)
+        v = np.frombuffer(blobs[1], dtype=dt).reshape(shp)
+        return {
+            **state,
+            "k": state["k"].at[:, rows].set(jnp.asarray(k)),
+            "v": state["v"].at[:, rows].set(jnp.asarray(v)),
+        }
+
 
 class Int8PagedKVCache(PagedKVCache):
     """Paged layout with int8 KV pages: each pool row stores symmetric
@@ -291,6 +376,38 @@ class Int8PagedKVCache(PagedKVCache):
     def cache_bytes(self, state: Cache) -> int:
         return int(state["k"].nbytes + state["v"].nbytes
                    + state["ks"].nbytes + state["vs"].nbytes)
+
+    # -- page migration ------------------------------------------------------
+    def _storage_dtype(self):
+        return jnp.int8
+
+    def export_pages(self, state: Cache, pages):
+        """int8 pages travel WITH their per-page fp32 scale columns
+        (``ks``/``vs`` ``[n_layer]`` per page) — the payload is
+        self-describing, so the importer dequantizes exactly as the
+        exporter would even if its own constructor scales differ."""
+        meta, blobs = super().export_pages(state, pages)
+        p = np.asarray(pages, np.int64)
+        ks = np.ascontiguousarray(np.asarray(state["ks"][:, p], np.float32))
+        vs = np.ascontiguousarray(np.asarray(state["vs"][:, p], np.float32))
+        return meta, blobs + [ks.tobytes(), vs.tobytes()]
+
+    def import_pages(self, state: Cache, pages, meta: dict, blobs) -> Cache:
+        self._check_meta(meta, 4, blobs)
+        sshp = (self.n_layer, len(pages))
+        want = int(np.prod(sshp)) * 4
+        if len(blobs[2]) != want or len(blobs[3]) != want:
+            raise ValueError("page payload scale bytes %d/%d != %d"
+                             % (len(blobs[2]), len(blobs[3]), want))
+        state = super().import_pages(state, pages, meta, blobs[:2])
+        p = np.asarray(pages, np.int64)
+        ks = np.frombuffer(blobs[2], dtype=np.float32).reshape(sshp)
+        vs = np.frombuffer(blobs[3], dtype=np.float32).reshape(sshp)
+        return {
+            **state,
+            "ks": state["ks"].at[:, p].set(jnp.asarray(ks)),
+            "vs": state["vs"].at[:, p].set(jnp.asarray(vs)),
+        }
 
 
 class ContiguousKVCache(_KVCacheBase):

@@ -37,6 +37,7 @@ from .table import (  # noqa: F401
     record,
     reset_provenance,
     resolve_decode_fuse,
+    resolve_fleet_roles,
     resolve_fleet_router,
     resolve_speculation_k,
     shipped_path,
@@ -48,7 +49,8 @@ __all__ = [
     "bucket_ctx", "bucket_nv", "bucket_rows", "bucket_seq", "bucket_slots",
     "device_kind", "normalize_device_kind", "pow2_floor",
     "lookup", "record", "table_path", "shipped_path",
-    "resolve_decode_fuse", "resolve_fleet_router", "resolve_speculation_k",
+    "resolve_decode_fuse", "resolve_fleet_roles", "resolve_fleet_router",
+    "resolve_speculation_k",
     "provenance_snapshot", "reset_provenance",
     "SearchResult", "median_time_ms", "search",
     "Tunable", "register_tunable", "get_tunable", "registered_tunables",

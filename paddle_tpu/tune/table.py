@@ -50,7 +50,8 @@ __all__ = [
     "bucket_ctx",
     "table_path", "shipped_path", "entry_key",
     "lookup", "record", "read_entries", "write_entries",
-    "resolve_decode_fuse", "resolve_fleet_router", "resolve_speculation_k",
+    "resolve_decode_fuse", "resolve_fleet_roles", "resolve_fleet_router",
+    "resolve_speculation_k",
     "provenance_snapshot", "reset_provenance",
 ]
 
@@ -386,6 +387,29 @@ def resolve_fleet_router(cpus: Optional[int] = None
                    "affinity": cfg.get("affinity", "prefix")}
             if out["affinity"] in ("prefix", "round_robin"):
                 return out, src
+    except Exception:
+        pass
+    return default, "default"
+
+
+def resolve_fleet_roles(cpus: Optional[int] = None
+                        ) -> Tuple[Dict[str, int], str]:
+    """(role mix, source) for a disaggregated fleet — THE shared
+    resolution ``fleet.FleetConfig(roles="auto")`` and
+    ``tools/fleet_bench`` both use. The config dict carries ``prefill``
+    and ``decode`` (replica counts per role), bucketed by host CPU count
+    like ``fleet.router``. ``({"prefill": 1, "decode": 1}, "default")``
+    on no entry or any table failure: a role-split fleet must come up
+    with no table on disk."""
+    default = {"prefill": 1, "decode": 1}
+    try:
+        if cpus is None:
+            cpus = os.cpu_count() or 1
+        cfg, src = lookup("fleet.roles", bucket_slots(int(cpus)))
+        if cfg and int(cfg.get("prefill", 0)) > 0 \
+                and int(cfg.get("decode", 0)) > 0:
+            return ({"prefill": int(cfg["prefill"]),
+                     "decode": int(cfg["decode"])}, src)
     except Exception:
         pass
     return default, "default"

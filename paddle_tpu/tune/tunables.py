@@ -722,3 +722,81 @@ class FleetRouterTunable(Tunable):
             except Exception:
                 pass
         self._open.clear()
+
+
+@register_tunable("fleet.roles")
+class FleetRolesTunable(Tunable):
+    """Prefill/decode role mix for a disaggregated fleet. Measured as
+    end-to-end drain time of a bursty mixed stream (long shared-prefix
+    prompts + short follow-ups) through an in-process sim fleet whose
+    cost model charges per-token prefill time, multiplied when prefill
+    interleaves with in-flight decode (the mixed-batch interference that
+    motivates disaggregation). More prefill replicas absorb prompt
+    bursts; more decode replicas carry the token streams — the right
+    split depends on the host, so it is measured. Bucketed by host CPU
+    count, like ``fleet.router``."""
+
+    kernel = "fleet.roles"
+
+    def __init__(self):
+        self._open: list = []
+
+    def default_shapes(self):
+        import os as _os
+
+        return [dict(cpus=_os.cpu_count() or 1, slots=4, step_ms=0.5,
+                     prefill_ms_per_token=0.2, interference=3.0,
+                     page_size=16, n_requests=24, prompt_len=48,
+                     max_new=8)]
+
+    def bucket(self, shape):
+        return _table.bucket_slots(shape["cpus"])
+
+    def candidates(self, shape):
+        return [{"prefill": p, "decode": d}
+                for p, d in ((1, 1), (1, 2), (1, 3), (2, 2))]
+
+    def default_config(self, shape):
+        return {"prefill": 1, "decode": 1}
+
+    def build(self, shape, config):
+        from ..fleet import FleetConfig, Router, SimConfig, SimEngine
+
+        ps = int(shape["page_size"])
+        router = Router(FleetConfig(
+            roles={"prefill": int(config["prefill"]),
+                   "decode": int(config["decode"])},
+            mode="inprocess", affinity="round_robin", page_size=ps,
+            engine_factory=lambda i: SimEngine(SimConfig(
+                slots=shape["slots"], step_ms=shape["step_ms"],
+                page_size=ps,
+                prefill_ms_per_token=shape["prefill_ms_per_token"],
+                interference=shape["interference"]))))
+        self._open.append(router)
+        n_requests = int(shape["n_requests"])
+        prompt_len = int(shape["prompt_len"])
+        max_new = int(shape["max_new"])
+
+        def drive():
+            frs = []
+            for i in range(n_requests):
+                # a burst of distinct long prompts (prefill-heavy) mixed
+                # with short follow-ups (decode-heavy)
+                if i % 3:
+                    prompt = [i * 131 + t for t in range(prompt_len)]
+                else:
+                    prompt = [7, 11, i % 5]
+                frs.append(router.submit(prompt, max_new))
+            ok = router.wait_all(120.0)
+            assert ok and all(f.terminal for f in frs)
+            return len(frs)
+
+        return drive, ()
+
+    def cleanup(self):
+        for router in self._open:
+            try:
+                router.close()
+            except Exception:
+                pass
+        self._open.clear()

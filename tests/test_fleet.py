@@ -1,8 +1,11 @@
 """Fleet subsystem tests: prefix-cache hashing/LRU/poisoning, the frame
-protocol, router exactly-once accounting under kill/restart, and
-cross-process telemetry aggregation (ISSUE 15 tentpole coverage). Router
-tests run on in-process sim engines — the process-worker path is covered
-by tools/fleet_bench and tools/chaos_drill (smoke gates)."""
+protocol (JSON + binary page-payload frames), router exactly-once
+accounting under kill/restart, cross-process telemetry aggregation
+(ISSUE 15 tentpole coverage), and the disaggregation plane — KV-page
+serialization round-trips on every cache layout, cross-replica prefix
+shipping, and scale-down migration (ISSUE 18). Router tests run on
+in-process sim engines — the process-worker path is covered by
+tools/fleet_bench and tools/chaos_drill (smoke gates)."""
 
 import io
 import os
@@ -15,8 +18,9 @@ from paddle_tpu.fleet import (FleetBackpressure, FleetConfig, FleetRequest,
                               PrefixCache, Router, SimConfig, SimEngine,
                               aggregate_telemetry, prefix_key)
 from paddle_tpu.fleet import metrics as fm
-from paddle_tpu.fleet.protocol import MAX_FRAME, FrameReader, read_frame, \
-    send_frame
+from paddle_tpu.fleet.protocol import (MAX_FRAME, Binary, FrameReader,
+                                       pack_pages, read_frame, send_frame,
+                                       send_binary_frame, unpack_pages)
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -160,6 +164,93 @@ class TestProtocol:
             assert reader.drain() == [] and reader.eof
         finally:
             os.close(r)
+
+
+# -- binary page-payload frames (ISSUE 18) ------------------------------------
+class TestBinaryFrames:
+    def test_mixed_json_and_binary_round_trip(self):
+        """Binary frames interleave with JSON on the same stream; the
+        length-word top bit tells them apart, bytes come back verbatim."""
+        buf = io.BytesIO()
+        payload = bytes(range(256)) * 7
+        send_frame(buf, {"op": "submit", "id": 1})
+        send_binary_frame(buf, payload)
+        send_frame(buf, {"ev": "result", "id": 1})
+        buf.seek(0)
+        assert read_frame(buf) == {"op": "submit", "id": 1}
+        got = read_frame(buf)
+        assert isinstance(got, Binary) and got.payload == payload
+        assert read_frame(buf) == {"ev": "result", "id": 1}
+        assert read_frame(buf) is None
+
+    def test_torn_binary_frame_is_eof_not_garbage(self):
+        buf = io.BytesIO()
+        send_binary_frame(buf, b"kvpagebytes" * 100)
+        data = buf.getvalue()
+        for cut in (1, 3, 4, 10, len(data) - 1):
+            assert read_frame(io.BytesIO(data[:cut])) is None
+
+    def test_oversized_binary_rejected_both_ends(self):
+        """The sender refuses before poisoning the stream; a reader that
+        sees an oversize binary length word raises the same typed error
+        (both sides treat it as a corrupt stream, not a big payload)."""
+        with pytest.raises(ValueError, match="exceeds MAX_FRAME"):
+            send_binary_frame(io.BytesIO(), b"\0" * (MAX_FRAME + 1))
+        word = (0x80000000 | (MAX_FRAME + 1)).to_bytes(4, "big")
+        with pytest.raises(ValueError, match="exceeds MAX_FRAME"):
+            read_frame(io.BytesIO(word + b"x"))
+        r, w = os.pipe()
+        try:
+            os.set_blocking(r, False)
+            reader = FrameReader(r)
+            os.write(w, word + b"x")
+            with pytest.raises(ValueError, match="exceeds MAX_FRAME"):
+                reader.drain()
+        finally:
+            os.close(r)
+            os.close(w)
+
+    def test_reader_reassembles_split_binary_writes(self):
+        """A binary frame dripped through a pipe in small chunks (the
+        kernel tears large page payloads across reads) reassembles into
+        one Binary; the torn tail stays buffered between drains."""
+        r, w = os.pipe()
+        try:
+            os.set_blocking(r, False)
+            reader = FrameReader(r)
+            buf = io.BytesIO()
+            send_frame(buf, {"n": 1})
+            send_binary_frame(buf, bytes(range(251)) * 5)
+            send_frame(buf, {"n": 2})
+            data = buf.getvalue()
+            got = []
+            for i in range(0, len(data), 7):
+                os.write(w, data[i:i + 7])
+                got.extend(reader.drain())
+            assert len(got) == 3
+            assert got[0] == {"n": 1} and got[2] == {"n": 2}
+            assert isinstance(got[1], Binary)
+            assert got[1].payload == bytes(range(251)) * 5
+            os.close(w)
+            assert reader.drain() == [] and reader.eof
+        finally:
+            os.close(r)
+
+    def test_pack_unpack_pages_round_trip(self):
+        meta = {"ev": "pages", "xid": 7, "layout": "paged", "n_pages": 2}
+        blobs = [b"k" * 1000, b"v" * 1000, b"", b"\x00\xff" * 8]
+        meta2, blobs2 = unpack_pages(pack_pages(meta, blobs))
+        assert blobs2 == blobs
+        assert {k: meta2[k] for k in meta} == meta
+        assert meta2["blob_lens"] == [1000, 1000, 0, 16]
+
+    def test_torn_page_payload_raises_typed_error(self):
+        payload = pack_pages({"ev": "pages", "xid": 1}, [b"abc" * 64])
+        for cut in (2, 6, len(payload) - 1):
+            with pytest.raises(ValueError, match="torn page payload"):
+                unpack_pages(payload[:cut])
+        with pytest.raises(ValueError, match="torn page payload"):
+            unpack_pages(payload + b"extra")
 
 
 # -- router over in-process sims ----------------------------------------------
@@ -633,3 +724,321 @@ class TestEnginePrefixCache:
         assert eng.page_accounting_ok()
         eng.drain(10.0)
         assert eng.pool.num_used == 0
+
+
+# -- KV-page serialization (ISSUE 18: every layout round-trips or refuses) ----
+class TestPagePayloadLayouts:
+    @staticmethod
+    def _fp_cache():
+        import jax.numpy as jnp
+
+        from paddle_tpu.serving.kv_cache import PagedKVCache
+
+        return PagedKVCache(n_layer=2, n_head=2, d_head=4, slots=2,
+                            max_ctx=32, page_size=8, num_pages=6,
+                            dtype=jnp.float32)
+
+    @staticmethod
+    def _fill(cache, state, seed):
+        import jax.numpy as jnp
+        import numpy as np
+
+        rng = np.random.RandomState(seed)
+        shp = state["k"].shape
+        return {**state,
+                "k": jnp.asarray(rng.randn(*shp).astype(state["k"].dtype)
+                                 if state["k"].dtype != np.int8 else
+                                 rng.randint(-127, 128, shp, np.int8)),
+                "v": jnp.asarray(rng.randn(*shp).astype(state["v"].dtype)
+                                 if state["v"].dtype != np.int8 else
+                                 rng.randint(-127, 128, shp, np.int8))}
+
+    def test_fp_paged_round_trip_bit_exact(self):
+        """fp pages exported from one pool and imported into DIFFERENT
+        page ids of another re-export the exact same bytes — raw C-order
+        rows, no float formatting anywhere in the path."""
+        src = self._fp_cache()
+        s_state = self._fill(src, src.init_state(), seed=1)
+        meta, blobs = src.export_pages(s_state, [1, 3])
+        assert meta["n_pages"] == 2 and len(blobs) == 2
+        dst = self._fp_cache()
+        d_state = self._fill(dst, dst.init_state(), seed=2)  # noisy pool
+        d_state = dst.import_pages(d_state, [4, 2], meta, blobs)
+        meta2, blobs2 = dst.export_pages(d_state, [4, 2])
+        assert blobs2 == blobs, "fp page bytes mutated in transit"
+        assert {k: meta2[k] for k in meta} == meta
+
+    def test_int8_paged_round_trip_carries_scales(self):
+        """int8 pages travel with their per-page fp32 scale columns; the
+        importer's own constructor scales never touch imported pages, so
+        the re-export is bit-exact including the scales."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from paddle_tpu.serving.kv_cache import Int8PagedKVCache
+
+        def mk(ks, vs):
+            return Int8PagedKVCache(n_layer=2, n_head=2, d_head=4, slots=2,
+                                    max_ctx=32, page_size=8, num_pages=6,
+                                    k_scale=ks, v_scale=vs)
+
+        src = mk(0.125, 0.25)
+        s_state = self._fill(src, src.init_state(), seed=3)
+        # vary the per-page scale columns so the test catches a payload
+        # that ships the constructor scalar instead of the page columns
+        rng = np.random.RandomState(4)
+        s_state = {**s_state,
+                   "ks": jnp.asarray(rng.rand(2, 6).astype(np.float32) + .1),
+                   "vs": jnp.asarray(rng.rand(2, 6).astype(np.float32) + .1)}
+        meta, blobs = src.export_pages(s_state, [0, 5])
+        assert len(blobs) == 4, "int8 payload must carry ks/vs columns"
+        dst = mk(1.0, 1.0)  # different calibration on purpose
+        d_state = dst.import_pages(dst.init_state(), [2, 4], meta, blobs)
+        meta2, blobs2 = dst.export_pages(d_state, [2, 4])
+        assert blobs2 == blobs, "int8 pages or scale columns mutated"
+        got_ks = np.asarray(d_state["ks"][:, [2, 4]])
+        want_ks = np.asarray(s_state["ks"][:, [0, 5]])
+        assert np.array_equal(got_ks, want_ks), \
+            "imported pages dequantize with the wrong scales"
+
+    def test_contiguous_layout_refuses_typed(self):
+        """The dense layout has no addressable page unit: both directions
+        refuse with ValueError — callers surface 'migration unsupported',
+        never a crash or a silent wrong-shape blob."""
+        from paddle_tpu.serving.kv_cache import ContiguousKVCache
+
+        cache = ContiguousKVCache(n_layer=1, n_head=2, d_head=4, slots=2,
+                                  max_ctx=16)
+        state = cache.init_state()
+        with pytest.raises(ValueError, match="no pages to export"):
+            cache.export_pages(state, [0])
+        with pytest.raises(ValueError, match="no pages to import"):
+            cache.import_pages(state, [0], {"layout": "contiguous"}, [b""])
+
+    def test_import_refuses_geometry_mismatch(self):
+        """Every mismatch is a typed ValueError BEFORE any pool write:
+        wrong page_size, wrong blob count, wrong page count, short blobs."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.serving.kv_cache import PagedKVCache
+
+        src = self._fp_cache()
+        state = self._fill(src, src.init_state(), seed=5)
+        meta, blobs = src.export_pages(state, [1, 3])
+        other = PagedKVCache(n_layer=2, n_head=2, d_head=4, slots=2,
+                             max_ctx=32, page_size=16, num_pages=3,
+                             dtype=jnp.float32)
+        with pytest.raises(ValueError, match="geometry mismatch"):
+            other.import_pages(other.init_state(), [1], meta, blobs)
+        dst = self._fp_cache()
+        with pytest.raises(ValueError, match="blobs"):
+            dst.import_pages(dst.init_state(), [1, 3], meta, blobs[:1])
+        with pytest.raises(ValueError, match="pages"):
+            dst.import_pages(dst.init_state(), [1], meta, blobs)
+        with pytest.raises(ValueError, match="bytes"):
+            dst.import_pages(dst.init_state(), [1, 3], meta,
+                             [blobs[0][:-4], blobs[1]])
+
+    def test_engine_export_ingest_serves_bit_identical(self, tiny_model):
+        """Engine-level round trip: a prefix prefilled on engine A,
+        shipped as (meta, blobs), and ingested by engine B serves the
+        same request on B as a RESUME — zero prefill dispatches, the
+        sampled stream bit-identical, page accounting intact on both."""
+        from paddle_tpu.serving import metrics as sm
+
+        prompt = list(range(1, 18))  # 16 cached tokens = 2 pages of 8
+        eng_a = _prefix_engine(tiny_model)
+        eng_b = _prefix_engine(tiny_model)
+        r1 = eng_a.submit(prompt, 5, temperature=0.8, seed=11)
+        eng_a.run()
+        assert r1.state == "finished"
+        exported = eng_a.export_prefix_pages(prompt[:16])
+        assert exported is not None, "donated prefix not exportable"
+        meta, blobs = exported
+        assert eng_b.ingest_prefix_pages(prompt[:16], meta, blobs)
+        assert eng_b.page_accounting_ok()
+        p0 = sm.PREFILL_COUNT.value
+        r2 = eng_b.submit(prompt, 5, temperature=0.8, seed=11)
+        eng_b.run()
+        assert r2.state == "finished"
+        assert list(r2.tokens_out) == list(r1.tokens_out), \
+            "shipped pages changed the sampled stream"
+        assert sm.PREFILL_COUNT.value == p0, \
+            "the ingested prefix did not spare the prefill dispatch"
+        # re-export from B: the bytes survived the hop bit-exact
+        meta_b, blobs_b = eng_b.export_prefix_pages(prompt[:16])
+        assert blobs_b == blobs
+        for eng in (eng_a, eng_b):
+            assert eng.page_accounting_ok()
+            eng.drain(10.0)
+            assert eng.pool.num_used == 0, "pages leaked through drain"
+
+    def test_ingest_refusals_leak_nothing(self, tiny_model):
+        """Every ingest refusal frees its reservation first: bad token
+        count, geometry mismatch, duplicate ingest — pool usage is
+        unchanged and page accounting holds after each."""
+        prompt = list(range(1, 18))
+        eng_a = _prefix_engine(tiny_model)
+        eng_b = _prefix_engine(tiny_model)
+        r = eng_a.submit(prompt, 3, seed=2)
+        eng_a.run()
+        assert r.state == "finished"
+        meta, blobs = eng_a.export_prefix_pages(prompt[:16])
+        used0 = eng_b.pool.num_used
+        # token count disagrees with n_pages * page_size
+        assert not eng_b.ingest_prefix_pages(prompt[:12], meta, blobs)
+        # geometry lie: n_pages beyond the payload
+        bad = dict(meta, n_pages=3)
+        assert not eng_b.ingest_prefix_pages(prompt[:16] + [77] * 8,
+                                             bad, blobs)
+        assert eng_b.pool.num_used == used0 and eng_b.page_accounting_ok()
+        assert eng_b.ingest_prefix_pages(prompt[:16], meta, blobs)
+        # duplicate ingest: no-op success, no second reservation
+        used1 = eng_b.pool.num_used
+        assert eng_b.ingest_prefix_pages(prompt[:16], meta, blobs)
+        assert eng_b.pool.num_used == used1
+        eng_a.drain(10.0)
+        eng_b.drain(10.0)
+
+
+# -- disaggregation plane over in-process sims (ISSUE 18) ---------------------
+def _disagg_router(roles, n_decode_slots=2, **kw):
+    kw.setdefault("affinity", "round_robin")
+    return Router(FleetConfig(
+        roles=roles, mode="inprocess", page_size=16,
+        engine_factory=lambda i: SimEngine(
+            SimConfig(slots=n_decode_slots, page_size=16)), **kw))
+
+
+class TestDisaggRouter:
+    def test_prefill_replicas_serve_no_user_requests(self):
+        """1-prefill/2-decode fleet: long prompts prefill on the prefill
+        replica and decode elsewhere; the streams match a uniform twin
+        bit-for-bit and the pages actually migrated."""
+        mc0 = fm.MIGRATIONS_COMPLETED.value
+        router = _disagg_router("1:2")
+        prompts = [[100 + i * 50 + t for t in range(33)] for i in range(4)]
+        frs = [router.submit(p, 4, temperature=0.5, seed=40 + i)
+               for i, p in enumerate(prompts)]
+        assert router.wait_all(30.0)
+        acc = router.accounting()
+        assert len(acc) == 4 and set(acc.values()) == {"finished"}, acc
+        assert all(f.last_replica != 0 for f in frs), \
+            "a user request decoded on the prefill replica"
+        assert fm.MIGRATIONS_COMPLETED.value > mc0, "nothing migrated"
+        snap = router.snapshot()
+        assert snap["roles"]["prefill"] == 1
+        assert snap["migration"]["active"] == 0
+        router.close()
+        twin = _sim_router(n=1)
+        frs_t = [twin.submit(p, 4, temperature=0.5, seed=40 + i)
+                 for i, p in enumerate(prompts)]
+        assert twin.wait_all(30.0)
+        twin.close()
+        assert [f.tokens for f in frs] == [f.tokens for f in frs_t], \
+            "disaggregated decode diverged from the uniform twin"
+
+    def test_remote_prefix_hit_ships_across_replicas(self):
+        """Uniform fleet with the fleet-wide index armed: a prefix owned
+        by replica A serves an identical request forced onto replica B by
+        shipping the pages — remote hit counted, stream unchanged."""
+        h0 = fm.REMOTE_HITS.value
+        router = Router(FleetConfig(
+            replicas=2, mode="inprocess", affinity="round_robin",
+            page_size=16, fleet_prefix=True,
+            engine_factory=lambda i: SimEngine(
+                SimConfig(slots=2, page_size=16))))
+        prompt = [7 * t % 97 for t in range(33)]
+        f1 = router.submit(prompt, 4, temperature=0.5, seed=9)
+        assert router.wait_all(30.0)
+        owner = f1.last_replica
+        router._replicas[owner].accepting = False
+        f2 = router.submit(prompt, 4, temperature=0.5, seed=9)
+        assert router.wait_all(30.0)
+        assert f2.state == "finished" and f2.last_replica == 1 - owner
+        assert f2.tokens == f1.tokens, \
+            "the remote prefix hit changed the stream"
+        assert fm.REMOTE_HITS.value > h0
+        router.close()
+
+    def test_failed_migration_falls_back_cold_exactly_once(self):
+        """Kill the DESTINATION while pages are in flight toward it: the
+        migration fails closed, the carried request blows its no-migrate
+        fuse and re-prefills cold — one terminal outcome, same stream."""
+        mf0 = fm.MIGRATIONS_FAILED.value
+        router = Router(FleetConfig(
+            replicas=2, mode="inprocess", affinity="round_robin",
+            page_size=16, fleet_prefix=True,
+            engine_factory=lambda i: SimEngine(
+                SimConfig(slots=2, page_size=16))))
+        prompt = [5 * t % 89 for t in range(33)]
+        f1 = router.submit(prompt, 4, temperature=0.5, seed=13)
+        assert router.wait_all(30.0)
+        owner = f1.last_replica
+        router._replicas[owner].accepting = False
+        f2 = router.submit(prompt, 4, temperature=0.5, seed=13)
+        deadline = 200
+        while not router._migrations and deadline:
+            router.pump()
+            deadline -= 1
+        assert router._migrations, "no migration started"
+        router._replicas[1 - owner].kill()
+        assert router.wait_all(30.0)
+        acc = router.accounting()
+        assert acc[f2.id] == "finished", acc
+        assert list(acc.values()).count("finished") == len(acc), acc
+        assert fm.MIGRATIONS_FAILED.value > mf0, \
+            "the dead owner's migration did not fail closed"
+        assert f2.no_migrate, "the failed request can retry migration"
+        assert f2.tokens == f1.tokens, "the cold fallback changed tokens"
+        router.close()
+
+    def test_manual_rebalance_moves_ownership(self):
+        """rebalance() is a MOVE: the pages ship to the destination and
+        are evicted at the source, and the fleet index re-points the
+        prefix at its new owner."""
+        mc0 = fm.MIGRATIONS_COMPLETED.value
+        router = Router(FleetConfig(
+            replicas=2, mode="inprocess", affinity="round_robin",
+            page_size=16, fleet_prefix=True,
+            engine_factory=lambda i: SimEngine(
+                SimConfig(slots=2, page_size=16))))
+        prompt = [3 * t % 83 for t in range(33)]
+        f1 = router.submit(prompt, 3, temperature=0.5, seed=21)
+        assert router.wait_all(30.0)
+        owner = f1.last_replica
+        key, ent = next(iter(router._prefix_index.items()))
+        assert ent["owners"] == {owner}
+        xid = router.rebalance(owner, 1 - owner, ent["tokens"])
+        assert xid is not None
+        for _ in range(200):
+            if not router._migrations:
+                break
+            router.pump()
+        assert not router._migrations, "rebalance never resolved"
+        assert fm.MIGRATIONS_COMPLETED.value > mc0
+        assert router._prefix_index[key]["owners"] == {1 - owner}, \
+            "ownership did not move with the pages"
+        router.close()
+
+    def test_scale_down_migrates_and_retires(self):
+        """scale_down drains a live replica: in-flight work re-lands
+        elsewhere, every request reaches one terminal outcome, and the
+        victim takes no further traffic."""
+        router = _sim_router(n=3)
+        frs = [router.submit([9, 9, 9, i], 6, temperature=0.4, seed=60 + i)
+               for i in range(6)]
+        for _ in range(2):
+            router.pump()
+        out = router.scale_down(1)
+        assert out["replica"] == 1
+        assert router.wait_all(30.0)
+        acc = router.accounting()
+        assert len(acc) == 6 and set(acc.values()) == {"finished"}, acc
+        snap = router.snapshot()
+        victim = next(r for r in snap["replicas"]
+                      if r["name"] == "replica-1")
+        assert victim["retired"] and not victim["alive"]
+        router.close()
+        # the retired slot stays down: nothing respawns it afterwards
+        assert not router._replicas[1].alive

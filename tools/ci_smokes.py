@@ -52,7 +52,7 @@ BUDGETS = {
     "paged_attention": 15.0,
     "profile_report": 15.0,
     "serve_bench": 75.0,   # speculative leg + its repetitive-stream drill
-    "fleet_bench": 30.0,
+    "fleet_bench": 75.0,  # + disagg QPS, remote-hit, and kill-migration legs
     "chaos_drill": 30.0,
     "fleet_trace": 10.0,
     "autotune": 15.0,

@@ -589,7 +589,13 @@ def selftest() -> int:
                  "fleet/prefix_cache/entries",
                  "fleet/prefix_cache/pages_held",
                  "fleet/prefix_cache/tokens_reused",
-                 "fleet/prefix_cache/poisoned_skipped"):
+                 "fleet/prefix_cache/poisoned_skipped",
+                 "fleet/migrations_started", "fleet/migrations_completed",
+                 "fleet/migrations_failed", "fleet/migrated_pages",
+                 "fleet/migration_ms",
+                 "fleet/prefix_cache/remote_hits",
+                 "fleet/prefix_cache/remote_misses",
+                 "fleet/prefix_cache/remote_ships"):
         assert name in snap, "missing fleet instrument %s" % name
     with tempfile.TemporaryDirectory() as td:
         base = os.path.join(td, "fleet")

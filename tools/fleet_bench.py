@@ -8,8 +8,13 @@
         rolling restart with zero rejected-by-bug, near-linear QPS
         scaling 1 -> 4 sim replicas over the worker protocol, a real
         ServingEngine prefix-cache leg (reduced prefill dispatches vs
-        cold), the fleet/* registry, and the run-ledger/perf-gate
-        mechanics. The smoke-gate entry (ROADMAP).
+        cold), the disaggregation legs — 2-prefill/2-decode beats 4
+        uniform on a bursty mixed stream (bit-identical tokens), a
+        remote prefix hit served by shipping KV pages across replicas
+        (real engines, binary page frames), SIGKILL mid-migration with
+        exactly-once accounting + unkilled-twin replay — the fleet/*
+        registry, and the run-ledger/perf-gate mechanics. The
+        smoke-gate entry (ROADMAP).
 
     python -m tools.fleet_bench [--requests N] [--replicas "1,2,4"]
                                 [--step-ms MS] [--slots S]
@@ -45,8 +50,36 @@ if _REPO not in sys.path:
 from paddle_tpu.monitor.metrics import sorted_percentile  # noqa: E402
 
 
-def _sim_spec(slots: int, step_ms: float) -> dict:
-    return {"engine": "sim", "sim": {"slots": slots, "step_ms": step_ms}}
+def _sim_spec(slots: int, step_ms: float, **sim_kw) -> dict:
+    return {"engine": "sim",
+            "sim": dict({"slots": slots, "step_ms": step_ms}, **sim_kw)}
+
+
+def _tiny_real_spec(page_size: int = 8) -> dict:
+    """A real ServingEngine small enough for CPU-sim workers, with the
+    prefix cache armed — the migration legs' engine."""
+    return {"engine": "real",
+            "model": {"vocab_size": 64, "n_layer": 1, "d_model": 16,
+                      "n_head": 2, "max_seq": 64},
+            "serving": {"slots": 2, "page_size": page_size, "max_seq": 64,
+                        "num_pages": 48, "prefix_cache_pages": 16}}
+
+
+def _mixed_stream(n_requests: int, prompt_len: int, max_new: int):
+    """The bursty mixed stream both disagg legs drive: distinct LONG
+    prompts (prefill-heavy — each forces a full prompt ingest) woven with
+    SHORT follow-ups (decode-heavy — they keep decode slots busy, so a
+    uniform replica's prefills land mid-decode and pay the mixed-batch
+    interference). Seeds are explicit so the two fleet shapes must
+    produce bit-identical streams."""
+    reqs = []
+    for i in range(n_requests):
+        if i % 2 == 0:
+            prompt = [(i * 131 + t) % 251 + 1 for t in range(prompt_len)]
+        else:
+            prompt = [3, 5, i % 7]
+        reqs.append((prompt, max_new, 1000 + i))
+    return reqs
 
 
 def run_scaling_leg(n_replicas: int, n_requests: int = 96,
@@ -150,6 +183,217 @@ def run_prefix_leg(n_requests: int = 8, prefix_pages: int = 8) -> dict:
             "prefill_dispatches_warm": prefills_warm,
             "prefix_hits": hits, "prefix_misses": misses,
             "hit_rate": round(hits / max(1, hits + misses), 3)}
+
+
+def _fresh_health(router, index: int, timeout_s: float = 10.0) -> dict:
+    """Ask replica ``index`` for a fresh health doc and pump until the
+    answer (with the engine-level fields) lands in the router's cache."""
+    router._replicas[index].health()
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        router.pump()
+        doc = router._health.get(index, {})
+        if "page_accounting_ok" in doc:
+            return doc
+        time.sleep(0.002)
+    raise AssertionError("no fresh health from replica %d" % index)
+
+
+def run_disagg_leg(n_requests: int = 24, prompt_len: int = 97,
+                   max_new: int = 6, step_ms: float = 1.0,
+                   slots: int = 4) -> dict:
+    """Disaggregation QPS leg (ISSUE 18 acceptance): the SAME bursty
+    mixed stream through 4 uniform replicas and through a 2-prefill /
+    2-decode fleet, sim engines in real worker processes. The sim cost
+    model charges ``prefill_ms_per_token`` per unknown prompt token and
+    multiplies it by ``interference`` when the ingest lands on a replica
+    with decodes in flight — the TPU mixed-batch stall. Prefill-role
+    replicas run one-token internal jobs that finish at admission and
+    never interleave with decodes, so the disagg fleet pays prompt
+    ingestion at 1x and ships the KV pages to a decode replica, while
+    every long prompt in the uniform fleet stalls a decoding batch at
+    ``interference``x. Streams must be bit-identical; QPS ratio > 1.0."""
+    from paddle_tpu.fleet import FleetConfig, Router
+    from paddle_tpu.fleet import metrics as fm
+
+    sim = dict(page_size=16, prefill_ms_per_token=0.4, interference=4.0)
+    reqs = _mixed_stream(n_requests, prompt_len, max_new)
+
+    def drive(cfg):
+        router = Router(cfg)
+        try:
+            t0 = time.perf_counter()
+            frs = [router.submit(p, m, temperature=0.6, seed=s)
+                   for p, m, s in reqs]
+            assert router.wait_all(120.0), router.accounting()
+            dt = time.perf_counter() - t0
+            acc = router.accounting()
+            assert len(acc) == n_requests \
+                and set(acc.values()) == {"finished"}, acc
+            return [f.tokens for f in frs], dt, router.snapshot()
+        finally:
+            router.close()
+
+    uni_streams, uni_dt, _ = drive(FleetConfig(
+        replicas=4, mode="process", affinity="round_robin",
+        engine_spec=_sim_spec(slots, step_ms, **sim),
+        max_outstanding=slots * 2))
+    mc0, mp0 = fm.MIGRATIONS_COMPLETED.value, fm.MIGRATED_PAGES.value
+    dis_streams, dis_dt, snap = drive(FleetConfig(
+        roles="2:2", mode="process", affinity="round_robin",
+        engine_spec=_sim_spec(slots, step_ms, **sim),
+        page_size=16, max_outstanding=slots * 2))
+    migrations = int(fm.MIGRATIONS_COMPLETED.value - mc0)
+    assert dis_streams == uni_streams, \
+        "disaggregation changed the generated streams"
+    assert migrations > 0, "disagg fleet migrated nothing"
+    assert snap["roles"]["prefill"] == 2 \
+        and snap["roles"]["decode"] == 2, snap["roles"]
+    ratio = (n_requests / dis_dt) / (n_requests / uni_dt)
+    assert ratio > 1.0, \
+        "2P/2D disagg did not beat 4 uniform: %.2fx" % ratio
+    return {"requests": n_requests,
+            "qps_uniform_4": round(n_requests / uni_dt, 3),
+            "qps_disagg_2p2d": round(n_requests / dis_dt, 3),
+            "qps_ratio": round(ratio, 3),
+            "migrations": migrations,
+            "migrated_pages": int(fm.MIGRATED_PAGES.value - mp0)}
+
+
+def run_remote_prefix_leg() -> dict:
+    """Fleet-wide prefix cache leg: two REAL tiny engines in worker
+    processes; request 1 prefills on its replica, then — with that owner
+    refusing new traffic — the identical request 2 must land on the
+    OTHER replica, served by shipping the owner's KV pages across the
+    pipe (binary page frames): a remote prefix hit, zero prefill
+    dispatches on the destination, bit-identical stream."""
+    from paddle_tpu.fleet import FleetConfig, Router
+    from paddle_tpu.fleet import metrics as fm
+
+    spec = _tiny_real_spec(page_size=8)
+    prompt = [(7 * t) % 60 + 1 for t in range(19)]  # 2 full pages + tail
+    h0, s0 = fm.REMOTE_HITS.value, fm.REMOTE_SHIPS.value
+    router = Router(FleetConfig(
+        replicas=2, mode="process", affinity="round_robin",
+        engine_spec=spec, fleet_prefix=True, page_size=8,
+        max_outstanding=4))
+    try:
+        f1 = router.submit(prompt, 5, temperature=0.8, seed=11)
+        assert router.wait_all(90.0), router.accounting()
+        owner = f1.last_replica
+        dst = 1 - owner
+        # the owner stops accepting: the only route for the identical
+        # request is the fleet index — ship owner pages to the peer
+        router._replicas[owner].accepting = False
+        f2 = router.submit(prompt, 5, temperature=0.8, seed=11)
+        assert router.wait_all(90.0), router.accounting()
+        assert f2.state == "finished" and f2.last_replica == dst, \
+            (f2.state, f2.last_replica, owner)
+        assert f2.tokens == f1.tokens, \
+            "remote prefix hit changed the stream: %s vs %s" \
+            % (f2.tokens, f1.tokens)
+        hits = int(fm.REMOTE_HITS.value - h0)
+        ships = int(fm.REMOTE_SHIPS.value - s0)
+        assert hits >= 1 and ships >= 1, (hits, ships)
+        hd = _fresh_health(router, dst)
+        assert hd["page_accounting_ok"], hd
+        assert hd.get("prefills", 0) == 0 and hd.get("resumes", 0) >= 1, \
+            "destination did not resume from shipped pages: %s" % hd
+    finally:
+        router.close()
+    # cold twin: the same request on a fresh single replica must produce
+    # the same stream (the migrated path changed routing, not tokens) —
+    # and it costs a prefill dispatch the remote hit avoided
+    twin = Router(FleetConfig(replicas=1, mode="process",
+                              engine_spec=spec, max_outstanding=4))
+    try:
+        ft = twin.submit(prompt, 5, temperature=0.8, seed=11)
+        assert twin.wait_all(90.0), twin.accounting()
+        assert ft.tokens == f1.tokens, (ft.tokens, f1.tokens)
+        ht = _fresh_health(twin, 0)
+        assert ht.get("prefills", 0) >= 1, ht
+    finally:
+        twin.close()
+    return {"remote_hits": hits, "remote_ships": ships,
+            "dst_prefills": hd.get("prefills"),
+            "dst_resumes": hd.get("resumes"),
+            "cold_prefills": ht.get("prefills")}
+
+
+def _selftest_migration_kill() -> None:
+    """SIGKILL mid-migration (ISSUE 18 acceptance): a 1-prefill/2-decode
+    process fleet loses a migration-involved worker to SIGKILL while KV
+    pages are in flight. Every request must still reach exactly one
+    terminal outcome (migrations fail closed: the carried requests fall
+    back to a cold prefill), the replay must be bit-identical to an
+    unkilled twin, page accounting must hold on every surviving replica,
+    and the kill -> migration-failed -> recovery story must be readable
+    from the fleet event log under one run_id."""
+    from paddle_tpu.fleet import FleetConfig, Router
+    from paddle_tpu.fleet import metrics as fm
+    from paddle_tpu.fleet.events import read_events
+
+    sim = dict(page_size=16, prefill_ms_per_token=1.0, interference=4.0)
+    reqs = _mixed_stream(10, 97, 6)
+
+    def cfg(elog=None):
+        return FleetConfig(roles="1:2", mode="process",
+                           affinity="round_robin", page_size=16,
+                           engine_spec=_sim_spec(4, 1.0, **sim),
+                           max_outstanding=8, event_log=elog)
+
+    mf0 = fm.MIGRATIONS_FAILED.value
+    with tempfile.TemporaryDirectory() as td:
+        elog = os.path.join(td, "events.jsonl")
+        router = Router(cfg(elog))
+        try:
+            frs = [router.submit(p, m, temperature=0.6, seed=s)
+                   for p, m, s in reqs]
+            deadline = time.monotonic() + 30.0
+            victim = None
+            while time.monotonic() < deadline:
+                router.pump()
+                if router._migrations:
+                    m = next(iter(router._migrations.values()))
+                    # the destination once pages are in flight, else the
+                    # source mid-prefill: either end dies mid-migration
+                    victim = m.dst if m.dst is not None else m.src
+                    break
+                time.sleep(0.001)
+            assert victim is not None, "no migration ever started"
+            router._replicas[victim].kill()  # SIGKILL, no goodbye
+            assert router.wait_all(90.0), router.accounting()
+            acc = router.accounting()
+            assert len(acc) == len(reqs) \
+                and set(acc.values()) == {"finished"}, \
+                "not exactly-once under mid-migration SIGKILL: %s" % acc
+            assert fm.MIGRATIONS_FAILED.value > mf0, \
+                "the killed replica's migration did not fail closed"
+            for i, rep in enumerate(router._replicas):
+                if rep.alive:
+                    assert _fresh_health(router, i)["page_accounting_ok"], \
+                        "page accounting broken on replica %d" % i
+        finally:
+            router.close()
+        evs = read_events(elog)
+        kinds = [e["kind"] for e in evs]
+        for needed in ("migration_start", "kill_detected",
+                       "migration_failed", "spawn"):
+            assert needed in kinds, "event log missing %r: %s" \
+                % (needed, sorted(set(kinds)))
+        rids = {e["run_id"] for e in evs}
+        assert len(rids) == 1, \
+            "kill story fragmented across run_ids: %s" % rids
+
+    twin = Router(cfg())
+    try:
+        frs_t = [twin.submit(p, m, temperature=0.6, seed=s)
+                 for p, m, s in reqs]
+        assert twin.wait_all(90.0), twin.accounting()
+        assert [f.tokens for f in frs] == [f.tokens for f in frs_t], \
+            "mid-migration SIGKILL replay diverged from the unkilled twin"
+    finally:
+        twin.close()
 
 
 # -- selftest -----------------------------------------------------------------
@@ -386,6 +630,12 @@ def selftest() -> int:
 
     prefix = run_prefix_leg()
 
+    # ISSUE 18: disaggregation beats uniform, remote prefix hits serve
+    # across replicas, SIGKILL mid-migration stays exactly-once
+    disagg = run_disagg_leg()
+    remote = run_remote_prefix_leg()
+    _selftest_migration_kill()
+
     # fleet/* registry: the full instrument set must be live
     import paddle_tpu.fleet.metrics  # noqa: F401
 
@@ -394,7 +644,12 @@ def selftest() -> int:
                  "fleet/completed", "fleet/replica_restarts",
                  "fleet/queue_depth", "fleet/prefix_cache/hits",
                  "fleet/prefix_cache/evictions",
-                 "fleet/prefix_cache/poisoned_skipped"):
+                 "fleet/prefix_cache/poisoned_skipped",
+                 "fleet/migrations_started", "fleet/migrations_completed",
+                 "fleet/migrations_failed", "fleet/migrated_pages",
+                 "fleet/migration_ms", "fleet/prefix_cache/remote_hits",
+                 "fleet/prefix_cache/remote_misses",
+                 "fleet/prefix_cache/remote_ships"):
         assert name in reg, "missing fleet instrument %s" % name
 
     # run-ledger + perf-gate mechanics on a throwaway ledger: one config
@@ -414,6 +669,10 @@ def selftest() -> int:
                 if isinstance(v, (int, float))}
         configs["fleet_prefix"] = {k: v for k, v in prefix.items()
                                    if isinstance(v, (int, float))}
+        configs["fleet_disagg"] = {k: v for k, v in disagg.items()
+                                   if isinstance(v, (int, float))}
+        configs["fleet_remote_prefix"] = {
+            k: v for k, v in remote.items() if isinstance(v, (int, float))}
         for _ in range(5):
             rec = runlog.record_run("fleet_bench", configs)
         assert rec.get("ledger_path") == led
@@ -430,10 +689,15 @@ def selftest() -> int:
             os.environ["PADDLE_TPU_RUN_LEDGER"] = prev_env
 
     print("fleet_bench selftest: OK (%.1fs)  scaling 1->4 = %.2fx "
-          "(qps %.0f -> %.0f); prefix hit_rate=%.2f prefills %d -> %d"
+          "(qps %.0f -> %.0f); prefix hit_rate=%.2f prefills %d -> %d; "
+          "disagg 2P/2D vs 4U = %.2fx (%d migrations, %d pages); "
+          "remote prefix hits=%d (dst prefills=%d resumes=%d)"
           % (time.perf_counter() - t0, scale, leg1["qps"], leg4["qps"],
              prefix["hit_rate"], prefix["prefill_dispatches_cold"],
-             prefix["prefill_dispatches_warm"]))
+             prefix["prefill_dispatches_warm"], disagg["qps_ratio"],
+             disagg["migrations"], disagg["migrated_pages"],
+             remote["remote_hits"], remote["dst_prefills"],
+             remote["dst_resumes"]))
     return 0
 
 
@@ -475,6 +739,8 @@ def fleet_bench(n_requests: int = 96, replica_counts=(1, 2, 4),
     if base and top:
         res["qps_scale"] = round(top["qps"] / base["qps"], 3)
     res["prefix"] = run_prefix_leg()
+    res["disagg"] = run_disagg_leg(step_ms=step_ms, slots=slots)
+    res["remote_prefix"] = run_remote_prefix_leg()
     return res
 
 
@@ -523,6 +789,13 @@ def main(argv=None) -> int:
                               {"fleet_%s" % name: cfg,
                                "fleet_prefix": {
                                    k: v for k, v in res["prefix"].items()
+                                   if isinstance(v, (int, float))},
+                               "fleet_disagg": {
+                                   k: v for k, v in res["disagg"].items()
+                                   if isinstance(v, (int, float))},
+                               "fleet_remote_prefix": {
+                                   k: v
+                                   for k, v in res["remote_prefix"].items()
                                    if isinstance(v, (int, float))}},
                               extra=leg_obs or None)
         res.update(runlog.tail_info())
