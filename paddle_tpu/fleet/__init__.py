@@ -11,12 +11,17 @@ request accounting, and per-replica telemetry aggregated into one fleet
 snapshot — plus the fleet observability plane: cross-process
 distributed tracing with clock-aligned merge (:mod:`.trace`,
 tools/fleet_trace.py), two-scope SLO evaluation over the telemetry
-rings (:mod:`.slo`) and the run-stamped fleet event journal
-(:mod:`.events`). See ROADMAP item 2, tools/fleet_bench.py and
-tools/fleet_top.py.
+rings (:mod:`.slo`), the run-stamped fleet event journal
+(:mod:`.events`), and the request autopsy plane (:mod:`.autopsy` +
+``serving.phases``): per-request phase ledgers derived from the merged
+span stream, ``fleet/phase/*`` latency budgets, and automatic
+SLO-breach root-cause verdicts (tools/fleet_autopsy.py). See ROADMAP
+item 2, tools/fleet_bench.py and tools/fleet_top.py.
 """
 
 from . import metrics  # registers every fleet/* instrument
+from .autopsy import (BreachAutopsy, autopsy_breaches, build_ledgers,
+                      phase_stats, run_autopsy)
 from .events import FleetEventLog, read_events
 from .prefix_cache import PrefixCache, PrefixEntry, prefix_key
 from .protocol import FrameReader, read_frame, send_frame
@@ -39,5 +44,7 @@ __all__ = [
     "FleetSLO", "fleet_slos_from_env", "merge_fleet_docs",
     "close_orphans", "fleet_request_spans", "load_fragments",
     "validate_fleet_spans",
+    "BreachAutopsy", "autopsy_breaches", "build_ledgers", "phase_stats",
+    "run_autopsy",
     "metrics",
 ]

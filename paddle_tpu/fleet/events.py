@@ -9,7 +9,10 @@ trace (per-request timelines). Every line is one JSON object::
 
 Kinds the router emits today: ``fleet_start``/``fleet_stop``, ``spawn``,
 ``kill_detected``, ``requeue``, ``reroute``, ``drain``, ``restart``,
-``rolling_restart``, ``slo_breach``/``slo_clear``. The vocabulary is
+``rolling_restart``, ``slo_breach``/``slo_clear``, and — when a traced
+run closes with breaches on the books — ``breach_autopsy`` (the typed
+:class:`~paddle_tpu.fleet.autopsy.BreachAutopsy` verdict joining the
+breach window against the span-derived phase ledger). The vocabulary is
 open — the SLO-driven autoscaler (ROADMAP item 3) will add ``scale``
 events through the same writer. Request-scoped events carry
 ``trace_id`` and replica-scoped ones ``replica``, so ledger records,
@@ -32,9 +35,17 @@ from typing import Any, Dict, List, Optional
 
 from ..monitor import runlog as _runlog
 
-__all__ = ["FleetEventLog", "read_events", "EVENT_SCHEMA"]
+__all__ = ["FleetEventLog", "read_events", "EVENT_SCHEMA",
+           "KIND_SLO_BREACH", "KIND_SLO_CLEAR", "KIND_BREACH_AUTOPSY"]
 
 EVENT_SCHEMA = "paddle_tpu.fleet_events/v1"
+
+# Event kinds tools join on (the rest of the vocabulary is free-form
+# strings at the emit sites; these three are cross-referenced by the
+# autopsy plane and its CLI, so they get names).
+KIND_SLO_BREACH = "slo_breach"
+KIND_SLO_CLEAR = "slo_clear"
+KIND_BREACH_AUTOPSY = "breach_autopsy"
 
 
 class FleetEventLog:

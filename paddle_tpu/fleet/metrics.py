@@ -10,8 +10,10 @@ module-level handles, a single disabled-branch per call.
 from __future__ import annotations
 
 from ..monitor import metrics as _mx
+from ..serving import phases as _phases
 
 __all__ = [
+    "PHASE_MS",
     "SUBMITTED", "ROUTED", "REQUEUED", "COMPLETED", "REJECTED",
     "DUPLICATE_RESULTS", "QUEUE_DEPTH", "REPLICAS_ALIVE",
     "REPLICA_RESTARTS", "ROLLING_RESTARTS", "NO_HEALTHY_REPLICA",
@@ -125,3 +127,15 @@ REMOTE_MISSES = _mx.counter(
 REMOTE_SHIPS = _mx.counter(
     "fleet/prefix_cache/remote_ships",
     help="prefix entries shipped between replicas' prefix caches")
+
+# Per-phase latency budgets (the request-autopsy plane): one histogram
+# per phase of the serving/phases.py taxonomy, observed per REQUEST from
+# the span-derived phase ledger when the router closes a traced run —
+# fleet/phase/<name>/ms explains where serving/request_latency_ms went.
+PHASE_MS = {
+    name: _mx.histogram(
+        "fleet/phase/%s/ms" % name,
+        help="per-request milliseconds attributed to the %r phase by the "
+             "span-derived phase ledger (serving/phases.py)" % name)
+    for name in _phases.PHASES
+}

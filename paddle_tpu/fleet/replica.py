@@ -42,6 +42,7 @@ from typing import Any, Dict, List, Optional
 from ..monitor import tracer as _tracer
 from ..reliability import faults as _faults
 from ..serving import metrics as _sm
+from ..serving import trace as _sv
 from ..serving.request import (FAILED, FINISHED, REJECTED, BackpressureError,
                                DrainingError, Request)
 from .protocol import (Binary, FrameReader, pack_pages, send_binary_frame,
@@ -80,7 +81,8 @@ class SimConfig:
                  vocab: int = 256, max_queue: int = 1024,
                  drain_timeout_s: float = 30.0, page_size: int = 16,
                  prefill_ms_per_token: float = 0.0,
-                 interference: float = 1.0):
+                 interference: float = 1.0,
+                 serving_spans: bool = False):
         self.slots = int(slots)
         self.step_ms = float(step_ms)
         self.vocab = int(vocab)
@@ -89,6 +91,13 @@ class SimConfig:
         self.page_size = max(1, int(page_size))
         self.prefill_ms_per_token = float(prefill_ms_per_token)
         self.interference = max(1.0, float(interference))
+        # Emit the serving-cat request-lifecycle spans (serving.trace) when
+        # the host tracer is armed. Default OFF: serving spans ride virtual
+        # tracks keyed by track NAME, so two in-process sims would collide
+        # on "serving slot k" — only the fleet WORKER (one engine per
+        # process) flips this on, giving the phase ledger the same span
+        # vocabulary the real engine emits.
+        self.serving_spans = bool(serving_spans)
 
 
 class SimEngine:
@@ -101,6 +110,7 @@ class SimEngine:
         self.cfg = config or SimConfig()
         self._queue: List[Request] = []
         self._running: List[Request] = []
+        self._free_slots: List[int] = list(range(self.cfg.slots))
         self._draining = False
         self._closed = False
         self._drain_active = False
@@ -130,6 +140,8 @@ class SimEngine:
                       trace_id=trace_id, attempt=attempt)
         self._queue.append(req)
         _sm.REQUESTS_SUBMITTED.inc()
+        if self.cfg.serving_spans:
+            _sv.on_submitted(req)
         return req
 
     def idle(self) -> bool:
@@ -152,13 +164,16 @@ class SimEngine:
                 return n
         return 0
 
-    def _prefill_stall(self, req: Request) -> None:
+    def _prefill_stall(self, req: Request) -> int:
         """The modeled prefill cost of admitting ``req``: per uncovered
         token, multiplied by ``interference`` when the stall lands in the
         middle of live decodes (the mixed-batch penalty disaggregation
-        exists to remove)."""
+        exists to remove). Returns the known-prefix length (the phase
+        ledger's local/resume cause attribution)."""
         if self.cfg.prefill_ms_per_token <= 0:
-            return
+            if self.cfg.serving_spans:
+                return self._known_prefix_len(req.prompt)
+            return 0
         known = self._known_prefix_len(req.prompt)
         if known:
             self._resumes += 1
@@ -169,6 +184,16 @@ class SimEngine:
             ms *= self.cfg.interference
         if ms > 0:
             time.sleep(ms / 1e3)
+        return known
+
+    def _retire(self, req: Request, state: str) -> None:
+        """Terminal bookkeeping shared by step() and drain(): emit the
+        lifecycle spans (when armed) and free the request's slot."""
+        if self.cfg.serving_spans:
+            _sv.on_terminal(req, state, req.slot)
+        if req.slot is not None:
+            self._free_slots.append(req.slot)
+            self._free_slots.sort()
 
     def step(self) -> List[Request]:
         """One sim cycle: admit into free slots (first token emitted at
@@ -179,39 +204,76 @@ class SimEngine:
         while self._queue and len(self._running) < self.cfg.slots:
             req = self._queue.pop(0)
             req.state = "running"
+            req.slot = self._free_slots.pop(0)
             req.admitted_t = time.perf_counter()
-            self._prefill_stall(req)
+            known = self._prefill_stall(req)
             n = self._cacheable_len(req.prompt_len)
             if n >= self.cfg.page_size:
                 # the sim donates at admission (prefilled rows exist now)
                 self._prefixes[tuple(int(t) for t in req.prompt[:n])] = True
             self._emit(req)
-            req.first_token_t = time.perf_counter()
+            # the +epsilon floor keeps the prefill span strictly inside
+            # the lifetime span even when the modeled stall is zero (the
+            # nesting validator treats equal-start spans as a partial
+            # overlap, and sub-µs windows truncate to equal starts)
+            req.first_token_t = max(time.perf_counter(),
+                                    req.admitted_t + 4e-6)
             self._running.append(req)
             _sm.REQUESTS_ADMITTED.inc()
+            if self.cfg.serving_spans:
+                _sv.on_admitted(req, req.slot)
+                _sv.on_prefill(req, req.slot, req.prompt_len,
+                               req.admitted_t + 2e-6, req.first_token_t,
+                               cause="resume" if known else "local")
+                _sm.TTFT_MS.observe(
+                    (req.first_token_t - req.submitted_t) * 1e3)
+                _sm.PREFILL_MS.observe(
+                    (req.first_token_t - req.admitted_t) * 1e3)
         _sm.QUEUE_DEPTH.set(len(self._queue))
         if not self._running:
             return finished
         # same chaos chokepoint as the real decode loop: a ``latency``
         # fault sleeps here, so per-replica fault plans can degrade one
-        # sim replica's tail without touching its peers
+        # sim replica's tail without touching its peers. The decode span
+        # window opens BEFORE the fault fires — injected decode latency
+        # lands inside the decode phase, where the autopsy should find it.
+        t0d = time.perf_counter()
+        if self.cfg.serving_spans:
+            # the epsilon-floored first_token_t of a just-admitted request
+            # can sit ahead of the wall clock; open the decode window at
+            # or after every prefill close so slot tracks stay well-nested
+            for req in self._running:
+                if req.first_token_t is not None:
+                    t0d = max(t0d, req.first_token_t)
         _faults.fire("serving.decode")
         if self.cfg.step_ms > 0:
             time.sleep(self.cfg.step_ms / 1e3)
         self.steps += 1
         still: List[Request] = []
+        done: List[Request] = []
         for req in self._running:
             if len(req.tokens_out) < req.max_new_tokens:
                 self._emit(req)
             if len(req.tokens_out) >= req.max_new_tokens:
-                req.state = FINISHED
-                req.finished_t = time.perf_counter()
-                finished.append(req)
-                _sm.REQUESTS_RETIRED.inc()
-                _sm.REQUEST_LATENCY_MS.observe(
-                    (req.finished_t - req.submitted_t) * 1e3)
+                done.append(req)
             else:
                 still.append(req)
+        t1d = max(time.perf_counter(), t0d)
+        if self.cfg.serving_spans:
+            by_slot: List[Optional[Request]] = [None] * self.cfg.slots
+            for req in self._running:
+                if req.slot is not None:
+                    by_slot[req.slot] = req
+            _sv.on_decode_chunk(by_slot, 1, t0d, t1d)
+            _sm.DECODE_STEP_MS.observe((t1d - t0d) * 1e3)
+        for req in done:
+            req.state = FINISHED
+            req.finished_t = max(time.perf_counter(), t1d)
+            finished.append(req)
+            _sm.REQUESTS_RETIRED.inc()
+            _sm.REQUEST_LATENCY_MS.observe(
+                (req.finished_t - req.submitted_t) * 1e3)
+            self._retire(req, FINISHED)
         self._running = still
         return finished
 
@@ -275,13 +337,17 @@ class SimEngine:
                 req.state = REJECTED
                 req.finished_t = time.perf_counter()
                 summary["rejected"] += 1
+                if self.cfg.serving_spans:
+                    _sv.on_terminal(req, REJECTED, None)
             self._queue = []
             deadline = time.monotonic() + timeout_s
             while self._running and time.monotonic() < deadline:
                 summary["finished"] += len(self.step())
             for req in self._running:
                 req.state = "timeout"
+                req.finished_t = time.perf_counter()
                 summary["timed_out"] += 1
+                self._retire(req, "timeout")
             self._running = []
             self.last_drain = summary
             self.close()

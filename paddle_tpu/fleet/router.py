@@ -59,9 +59,10 @@ from typing import Callable, Deque, Dict, List, Optional, Sequence
 from ..monitor import runlog as _runlog
 from ..monitor import tracer as _tr
 from ..serving.request import FAILED, FINISHED, REJECTED, TIMEOUT
+from . import autopsy as _autopsy
 from . import metrics as _fm
 from . import trace as _ftr
-from .events import FleetEventLog
+from .events import KIND_BREACH_AUTOPSY, FleetEventLog
 from .prefix_cache import prefix_key
 from .replica import InProcessReplica, ProcessReplica
 from .slo import FleetSLO, fleet_slos_from_env
@@ -426,6 +427,11 @@ class Router:
         self._slo_breached: Dict[int, dict] = {}  # replica -> last breach doc
         self._fleet_breach: Optional[dict] = None
         self._fleet_breach_count = 0
+        # every breach this run, scope-tagged: the close-time autopsy's
+        # input (bounded by dedup inside autopsy_breaches)
+        self._breach_log: List[dict] = []
+        self._phase_stats: Optional[dict] = None  # set by _run_autopsy
+        self._autopsies: List[dict] = []
         self._slo: Optional[FleetSLO] = None
         if config.slos and config.telemetry_base:
             self._slo = FleetSLO(
@@ -463,6 +469,7 @@ class Router:
     def _on_replica_slo_breach(self, index: int, breach) -> None:
         doc = breach.to_doc()
         self._slo_breached[index] = doc
+        self._breach_log.append(dict(doc, scope="replica", replica=index))
         self._emit_event("slo_breach", scope="replica", replica=index, **doc)
 
     def _on_replica_slo_clear(self, index: int) -> None:
@@ -472,6 +479,7 @@ class Router:
     def _on_fleet_slo_breach(self, breach) -> None:
         self._fleet_breach = breach.to_doc()
         self._fleet_breach_count += 1
+        self._breach_log.append(dict(self._fleet_breach, scope="fleet"))
         self._emit_event("slo_breach", scope="fleet", **self._fleet_breach)
 
     def _on_fleet_slo_clear(self) -> None:
@@ -1095,10 +1103,14 @@ class Router:
         if m.purpose == "remote_hit" and served:
             _fm.REMOTE_HITS.inc(len(served))
         if self._trace:
+            # phase-ledger tags: the ledger joins this window in as a
+            # ``ship`` interval of every request the migration served
             _ftr.on_lifecycle_span(
                 "migrate %s" % m.purpose, m.t0, time.perf_counter(),
                 args={"xid": m.xid, "src": m.src, "dst": m.dst,
-                      "pages": pages, "served": len(served)})
+                      "pages": pages, "served": len(served),
+                      "phase": "ship", "cause": m.purpose,
+                      "trace_ids": [fr.trace_id for fr in served][:8]})
         self._emit_event("migration_done", xid=m.xid, purpose=m.purpose,
                          key=m.key, src=m.src, dst=m.dst, pages=pages,
                          ms=round(dt_ms, 3), served=len(served))
@@ -1395,6 +1407,9 @@ class Router:
         # workers flushed their fragments on close (atexit); now the
         # router's own fragment + the merge manifest complete the set
         self._write_trace()
+        # the merged fragments exist and the event log is still open:
+        # replay the run through the phase ledger and autopsy any breach
+        self._run_autopsy()
         self._write_snapshot()
         if self._events is not None:
             self._events.close()
@@ -1415,6 +1430,43 @@ class Router:
         if self._own_tracer:
             _tr.stop_tracing()
             self._own_tracer = False
+
+    def _run_autopsy(self) -> None:
+        """Close-time request autopsy over the just-written trace: build
+        the phase ledgers from the merged fragments, feed the
+        ``fleet/phase/*`` histograms + snapshot stats, and — when this
+        run recorded SLO breaches — journal one typed ``breach_autopsy``
+        verdict per distinct breach in the event log (and the flight
+        ring). Best-effort: an autopsy failure must never take down
+        close()."""
+        if not self._trace:
+            return
+        try:
+            spans, manifest, _problems = _ftr.load_fragments(
+                self.cfg.trace_dir)
+            ledgers = _autopsy.build_ledgers(spans, manifest)
+            if not ledgers:
+                return
+            _autopsy.observe_phase_histograms(ledgers)
+            self._phase_stats = _autopsy.phase_stats(ledgers)
+            if not self._breach_log:
+                return
+            verdicts = _autopsy.autopsy_breaches(
+                self._breach_log, ledgers,
+                telemetry_base=self.cfg.telemetry_base)
+            self._autopsies = [v.to_doc() for v in verdicts]
+            from ..monitor import device as _dev
+
+            ring = _dev.flight_recorder()
+            for doc in self._autopsies:
+                self._emit_event(KIND_BREACH_AUTOPSY, **doc)
+                if ring is not None:
+                    ring.record_event(KIND_BREACH_AUTOPSY, **doc)
+        except Exception:
+            import logging
+
+            logging.getLogger("paddle_tpu").exception(
+                "breach autopsy failed (run artifacts are intact)")
 
     def __enter__(self) -> "Router":
         return self
@@ -1476,7 +1528,7 @@ class Router:
             if breach is not None:
                 health = dict(health, status="degraded", slo_breached=True,
                               slo=breach.get("slo"))
-            reps.append({
+            row = {
                 "name": rep.name, "alive": rep.alive,
                 "accepting": rep.accepting,
                 "role": rep.role,
@@ -1486,7 +1538,11 @@ class Router:
                 "completed": self._rep_done.get(idx, 0),
                 "qps": round(self._rep_done.get(idx, 0) / dt, 3),
                 "p99_ms": self._p99(lat),
-            })
+            }
+            if self._phase_stats is not None:
+                row["phases"] = self._phase_stats.get(
+                    "replicas", {}).get(idx, {})
+            reps.append(row)
         out = {"queue_depth": len(self._queue),
                "requests": sum(1 for fr in self._requests.values()
                                if not fr.internal),
@@ -1503,6 +1559,10 @@ class Router:
                 "prefix_index_entries": len(self._prefix_index)}
         if self.cfg.trace_dir:
             out["trace_dir"] = self.cfg.trace_dir
+        if self._phase_stats is not None:
+            out["phases"] = self._phase_stats.get("fleet", {})
+        if self._autopsies:
+            out["autopsies"] = self._autopsies
         if self._events is not None and self._events.armed:
             out["event_log"] = self._events.path
         if self._slo is not None:

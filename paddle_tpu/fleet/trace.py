@@ -95,11 +95,16 @@ def on_dispatch(fr, replica_index: int) -> None:
         return
     now = time.perf_counter()
     if fr.queued_since is not None:
+        # phase-ledger tags: the first wait is router queue time, every
+        # re-dispatch wait is a retry/requeue gap (serving/phases.py)
+        retry = fr.dispatches >= 2
         _tr.record_span(
             "queued", _us(fr.queued_since), _us(now) - _us(fr.queued_since),
             cat=CAT, track=QUEUE_TRACK,
             args={"trace_id": fr.trace_id, "attempt": fr.dispatches,
-                  "replica": replica_index})
+                  "replica": replica_index,
+                  "phase": "retry" if retry else "queue",
+                  "cause": "requeue" if retry else "router"})
     _tr.record_instant(
         "dispatch", _us(now), cat=CAT, track=replica_track(replica_index),
         args={"trace_id": fr.trace_id, "attempt": fr.dispatches})
@@ -134,7 +139,8 @@ def on_terminal(fr) -> None:
         _tr.record_span(
             "queued", _us(fr.queued_since), _us(end) - _us(fr.queued_since),
             cat=CAT, track=QUEUE_TRACK,
-            args={"trace_id": fr.trace_id, "attempt": None})
+            args={"trace_id": fr.trace_id, "attempt": None,
+                  "phase": "queue", "cause": "shed"})
     _tr.record_instant(
         fr.state, _us(end), cat=CAT, track=QUEUE_TRACK,
         args={"trace_id": fr.trace_id, "state": fr.state,

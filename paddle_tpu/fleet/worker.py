@@ -83,7 +83,12 @@ def _build_engine(spec: dict):
     if spec.get("engine", "real") == "sim":
         from .replica import SimConfig, SimEngine
 
-        return SimEngine(SimConfig(**spec.get("sim", {})))
+        cfg = SimConfig(**spec.get("sim", {}))
+        # one engine per worker process: serving-slot virtual tracks are
+        # collision-free here, so the sim emits the full serving-cat
+        # request lifecycle the phase ledger decomposes
+        cfg.serving_spans = True
+        return SimEngine(cfg)
     from ..models.decoder_lm import DecoderConfig, DecoderLM
     from ..serving.engine import ServingConfig, ServingEngine
 

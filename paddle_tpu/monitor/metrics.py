@@ -32,6 +32,8 @@ import re
 import threading
 from typing import Dict, List, Optional, Sequence
 
+from ..log import vlog
+
 __all__ = [
     "Counter", "Gauge", "Histogram",
     "counter", "gauge", "histogram",
@@ -220,6 +222,7 @@ class Histogram(_Instrument):
         self._count = 0
         self._min = math.inf
         self._max = -math.inf
+        self._overflow_warned = False
 
     def observe(self, v: float) -> None:
         if not _enabled:
@@ -250,7 +253,14 @@ class Histogram(_Instrument):
 
     def percentile(self, p: float) -> float:
         """Estimated p-th percentile (p in [0, 100]) by linear interpolation
-        within the containing bucket; exact-ish at the observed min/max."""
+        within the containing bucket; exact-ish at the observed min/max.
+
+        A rank landing in the +Inf overflow bucket CLAMPS to the top
+        finite bucket edge (one-time vlog) instead of interpolating
+        toward the observed max: the overflow bucket has no upper edge,
+        so interpolation there manufactures spuriously precise values a
+        single outlier drags arbitrarily high — the same honest-lower-
+        bound convention telemetry's interval percentiles use."""
         with self._lock:
             total = self._count
             if total == 0:
@@ -260,8 +270,16 @@ class Histogram(_Instrument):
             for i, c in enumerate(self._counts):
                 if c == 0:
                     continue
+                if i == len(self.bounds):
+                    if not self._overflow_warned:
+                        self._overflow_warned = True
+                        vlog(1, "histogram %s: p%g rank in +Inf overflow "
+                                "bucket — clamping to top edge %g (max "
+                                "observed %g); widen the buckets",
+                             self.name, p, self.bounds[-1], self._max)
+                    return self.bounds[-1]
                 lo = self.bounds[i - 1] if i > 0 else max(0.0, min(self._min, self.bounds[0]))
-                hi = self.bounds[i] if i < len(self.bounds) else max(self._max, self.bounds[-1])
+                hi = self.bounds[i]
                 if rank <= cum + c:
                     frac = (rank - cum) / c
                     est = lo + (hi - lo) * frac
@@ -296,6 +314,7 @@ class Histogram(_Instrument):
             self._counts = [0] * (len(self.bounds) + 1)
             self._sum = 0.0
             self._count = 0
+            self._overflow_warned = False
             self._min = math.inf
             self._max = -math.inf
 

@@ -31,6 +31,8 @@ recovers once the signal is healthy again.
 from __future__ import annotations
 
 import threading
+import time
+from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence
 
 from . import metrics as _mx
@@ -247,6 +249,11 @@ class SLOMonitor:
         self._lock = threading.Lock()
         self.breaches_total = 0
         self.last_breaches: List[Breach] = []
+        # bounded breach journal (wall-time-stamped docs): what the fleet
+        # autopsy joins against the phase ledger after the run — keeps the
+        # most recent breaches even when last_breaches was overwritten by
+        # a later clean tick
+        self.history: "deque" = deque(maxlen=64)
         self._spec_counters: Dict[str, _mx.Counter] = {
             s.name: _mx.counter("slo/%s/breaches" % s.name)
             for s in self.specs}
@@ -261,6 +268,8 @@ class SLOMonitor:
         with self._lock:
             self.last_breaches = breaches
             self.breaches_total += len(breaches)
+            for b in breaches:
+                self.history.append(dict(b.to_doc(), t=time.time()))
         if breaches:
             _c_breaches.inc(len(breaches))
             from . import device as _dev

@@ -145,6 +145,16 @@ class TelemetrySample:
         counts = {_parse_le(k): v for k, v in d["buckets"].items()}
         return _bucket_percentile(bounds, counts, p)
 
+    def histogram_interval_mean(self, name: str) -> Optional[float]:
+        """Mean of THIS interval's observations (delta sum / delta count;
+        None when the histogram saw nothing this interval) — exact, no
+        bucket interpolation, so the breach autopsy can rank replicas by
+        the interval a breach actually fired in."""
+        d = self.histogram_delta(name)
+        if not d or not d.get("count"):
+            return None
+        return float(d.get("sum", 0.0)) / float(d["count"])
+
 
 def _parse_le(key: str) -> float:
     if key == "le_inf":

@@ -35,6 +35,7 @@ from ..executor import _safe_flight_dump, aot_compile
 from ..monitor import device as _dev, slo as _slo, telemetry as _telemetry
 from ..reliability import faults as _faults
 from . import metrics as _sm
+from . import speculative as _speculative
 from . import trace as _trace
 from .kv_cache import ContiguousKVCache, Int8PagedKVCache, PagedKVCache
 from .page_pool import PagePool, PagePoolExhausted
@@ -826,7 +827,7 @@ class ServingEngine:
             jnp.asarray(req.seed, jnp.int32))
         tok = int(np.asarray(first_tok))
         t1 = time.perf_counter()
-        _trace.on_prefill(req, slot, bucket, t0, t1)
+        _trace.on_prefill(req, slot, bucket, t0, t1, cause="local")
         _sm.PREFILL_MS.observe((t1 - t0) * 1e3)
         _sm.PREFILL_COUNT.inc()
         self._prefills += 1
@@ -870,7 +871,7 @@ class ServingEngine:
             jnp.asarray(req.seed, jnp.int32))
         tok = int(np.asarray(first_tok))
         t1 = time.perf_counter()
-        _trace.on_prefill(req, slot, rbucket, t0, t1)
+        _trace.on_prefill(req, slot, rbucket, t0, t1, cause="resume")
         _sm.PREFILL_MS.observe((t1 - t0) * 1e3)
         # deliberately NOT PREFILL_COUNT: the bench's "reduced prefill
         # dispatches vs cold" assertion reads that counter
@@ -1058,9 +1059,18 @@ class ServingEngine:
                 return self._fail_inflight_batch(e)
         self._consecutive_failures = 0
         t1 = time.perf_counter()
+        spec_args = None
+        if dlen_np is not None:
+            # accepted drafts per slot = its run-steps beyond the first
+            # (step 0 consumes the pending token, never a draft)
+            runs = emitted.sum(axis=0)
+            proposed = int(dlen_np.sum())
+            accepted = int(np.maximum(runs - 1, 0).sum())
+            spec_args = _speculative.verify_window_args(steps, proposed,
+                                                        accepted)
         _trace.on_decode_chunk(
             [self.scheduler.slot_request(s) for s in range(self.cfg.slots)],
-            steps, t0, t1)
+            steps, t0, t1, spec=spec_args)
         _sm.DECODE_STEP_MS.observe((t1 - t0) * 1e3)
         _sm.DECODE_DISPATCHES.inc()
         # a verify dispatch is ONE windowed model step however wide the
@@ -1069,11 +1079,6 @@ class ServingEngine:
         _sm.DECODE_STEPS.inc(1 if dlen_np is not None else steps)
         _sm.TOKENS_GENERATED.inc(int(emitted.sum()))
         if dlen_np is not None:
-            # accepted drafts per slot = its run-steps beyond the first
-            # (step 0 consumes the pending token, never a draft)
-            runs = emitted.sum(axis=0)
-            proposed = int(dlen_np.sum())
-            accepted = int(np.maximum(runs - 1, 0).sum())
             _sm.SPEC_PROPOSED.inc(proposed)
             _sm.SPEC_ACCEPTED.inc(accepted)
             _sm.SPEC_REJECTED.inc(proposed - accepted)

@@ -47,7 +47,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 __all__ = ["Drafter", "NGramDrafter", "make_drafter", "residual_sample",
-           "SPEC_K_CAP", "parse_speculation"]
+           "SPEC_K_CAP", "parse_speculation", "verify_window_args"]
 
 # Bound on per-request draft k: the verify executable's window width is
 # k+1, and each distinct width compiles once — the cap keeps a hostile
@@ -135,6 +135,17 @@ def parse_speculation(value) -> Optional[object]:
         raise ValueError("speculation must be >= 0, 'auto' or None, got %r"
                          % (value,))
     return min(k, SPEC_K_CAP)
+
+
+def verify_window_args(window: int, proposed: int, accepted: int) -> dict:
+    """Span-arg payload tagging a verify dispatch for the phase ledger
+    (serving/phases.py): the window width (k+1 model positions), how many
+    draft tokens rode it and how many the target accepted.  Keeping the
+    attribution vocabulary here — next to the accept/reject math it
+    describes — means the engine, the trace reader and the autopsy plane
+    agree on one schema."""
+    return {"verify": True, "window": int(window),
+            "proposed": int(proposed), "accepted": int(accepted)}
 
 
 def residual_sample(p: np.ndarray, q: np.ndarray, draft_token: int,

@@ -86,34 +86,43 @@ def on_admitted(req, slot: int) -> None:
         "queued", _us(req.submitted_t),
         _us(req.admitted_t) - _us(req.submitted_t), cat=CAT,
         track=QUEUE_TRACK,
-        args=_targs(req, slot=slot))
+        args=_targs(req, slot=slot, phase="queue", cause="engine"))
 
 
-def on_prefill(req, slot: int, bucket: int, t0_s: float, t1_s: float) -> None:
+def on_prefill(req, slot: int, bucket: int, t0_s: float, t1_s: float,
+               cause: str = "local") -> None:
+    """``cause`` is the phase-ledger attribution: ``local`` for a cold
+    prefill, ``resume`` when the prompt resumed from cached/shipped
+    prefix pages (the remote-prefill consumption path)."""
     if not _tr.active():
         return
     _tr.record_span(
         "prefill(b=%d)" % bucket, _us(t0_s), _us(t1_s) - _us(t0_s), cat=CAT,
         track=slot_track(slot),
-        args=_targs(req, bucket=bucket, prompt_len=req.prompt_len))
+        args=_targs(req, bucket=bucket, prompt_len=req.prompt_len,
+                    phase="prefill", cause=cause))
 
 
 def on_decode_chunk(reqs_by_slot: Sequence, fuse: int, t0_s: float,
-                    t1_s: float) -> None:
+                    t1_s: float, spec: Optional[dict] = None) -> None:
     """One fused decode dispatch: a ``decode`` span on EVERY occupied
     slot's track (same wall window — that is the point: Perfetto shows
     which requests shared the dispatch). ``reqs_by_slot[k]`` is the
-    request in slot k or None."""
+    request in slot k or None. A speculative verify dispatch passes
+    ``spec`` (``serving.speculative.verify_window_args``): the span is
+    tagged phase ``verify`` and carries the accepted-k attribution the
+    phase ledger accumulates per request."""
     if not _tr.active():
         return
     ts, dur = _us(t0_s), _us(t1_s) - _us(t0_s)
+    extra = dict(spec, phase="verify") if spec else {"phase": "decode"}
     for slot, req in enumerate(reqs_by_slot):
         if req is None:
             continue
         _tr.record_span(
             "decode", ts, dur, cat=CAT, track=slot_track(slot),
             args=_targs(req, steps=fuse, pages_held=len(req.pages),
-                        generated=len(req.tokens_out)))
+                        generated=len(req.tokens_out), **extra))
 
 
 def on_terminal(req, state: str, slot: Optional[int]) -> None:
@@ -126,6 +135,15 @@ def on_terminal(req, state: str, slot: Optional[int]) -> None:
     label = {"finished": "retired", "failed": "FAILED",
              "timeout": "TIMEOUT"}.get(state, state)
     args = _targs(req, state=state, tokens_out=len(req.tokens_out))
+    # the engine-measured readouts ride the terminal instant so the phase
+    # ledger can check its decomposition against them (no new clocks —
+    # these are the same request timestamps the histograms observe)
+    if req.first_token_t is not None:
+        args["ttft_ms"] = round((req.first_token_t - req.submitted_t) * 1e3,
+                                3)
+    if req.finished_t is not None:
+        args["latency_ms"] = round((req.finished_t - req.submitted_t) * 1e3,
+                                   3)
     if slot is not None:
         track = slot_track(slot)
         _tr.record_span(
@@ -137,7 +155,7 @@ def on_terminal(req, state: str, slot: Optional[int]) -> None:
         _tr.record_span(
             "queued", _us(req.submitted_t),
             _us(req.finished_t) - _us(req.submitted_t), cat=CAT, track=track,
-            args=_targs(req, slot=None))
+            args=_targs(req, slot=None, phase="queue", cause="shed"))
     _tr.record_instant(label, _us(req.finished_t), cat=CAT, track=track,
                        args=args)
 

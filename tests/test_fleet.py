@@ -643,6 +643,83 @@ class TestFleetSpeculative:
         assert [f.tokens for f in frs] == [f.tokens for f in frs_t], \
             "a requeued speculative replay diverged from its unkilled twin"
 
+    def test_speculative_verify_spans_nest_in_decode_windows(
+            self, tiny_model, tmp_path):
+        """Trace-validator leg for the speculation/autopsy join: a traced
+        speculative fleet run must emit verify-tagged decode spans
+        (phase=verify, accepted <= proposed accounting) that nest inside
+        BOTH the request's serving lifetime span and the fleet attempt
+        (dispatch) window — the containment the phase ledger relies on to
+        attribute verify windows per request."""
+        import numpy as np
+
+        from paddle_tpu import serving
+        from paddle_tpu.fleet import trace as ftrace
+        from paddle_tpu.serving import trace as svtrace
+
+        trace_dir = str(tmp_path / "trace")
+
+        def factory(i):
+            return serving.ServingEngine(tiny_model, serving.ServingConfig(
+                slots=2, page_size=8, max_seq=64))
+
+        # ONE replica: two traced in-process engines would collide on the
+        # shared "serving slot <k>" virtual tracks
+        router = Router(FleetConfig(replicas=1, mode="inprocess",
+                                    affinity="round_robin",
+                                    engine_factory=factory,
+                                    trace_dir=trace_dir))
+        rng = np.random.RandomState(7)
+        prompts = [list(rng.randint(0, 64, 3)) * 4 for _ in range(3)]
+        frs = [router.submit(p, 6, speculation=4) for p in prompts]
+        assert router.wait_all(120.0)
+        router.close()
+
+        spans, manifest, problems = ftrace.load_fragments(trace_dir)
+        assert not problems and manifest.get("run_id")
+        digests = ftrace.validate_fleet_spans(spans)
+        assert digests.pop("_meta")["synthetic_closures"] == 0
+        # the serving-cat schedule is well-nested across the merged stream
+        svtrace.assert_well_nested(spans)
+
+        verify = [s for s in spans
+                  if s.get("cat") == "serving" and s["name"] == "decode"
+                  and (s.get("args") or {}).get("phase") == "verify"]
+        assert verify, "speculative run emitted no verify-tagged spans"
+        for s in verify:
+            a = s["args"]
+            assert a.get("verify") is True, a
+            assert 0 <= a["accepted"] <= a["proposed"], a
+            assert a.get("window", 0) >= 1, a
+        assert sum(s["args"]["proposed"] for s in verify) > 0
+
+        life = {(s.get("args") or {}).get("trace_id"):
+                (s["ts_us"], s["ts_us"] + s["dur_us"])
+                for s in spans
+                if s.get("cat") == "serving" and s["name"].startswith("req ")}
+        attempts = {((s.get("args") or {}).get("trace_id"),
+                     (s.get("args") or {}).get("attempt")):
+                    (s["ts_us"], s["ts_us"] + s["dur_us"])
+                    for s in spans
+                    if s.get("cat") == "fleet"
+                    and s["name"].startswith("attempt ")}
+        seen = set()
+        for s in verify:
+            a = s["args"]
+            tid = a["trace_id"]
+            seen.add(tid)
+            lo, hi = s["ts_us"], s["ts_us"] + s["dur_us"]
+            llo, lhi = life[tid]
+            assert llo <= lo and hi <= lhi, \
+                "verify span [%d,%d] escapes lifetime [%d,%d] of %s" \
+                % (lo, hi, llo, lhi, tid)
+            alo, ahi = attempts[(tid, a.get("attempt", 1))]
+            assert alo <= lo and hi <= ahi, \
+                "verify span [%d,%d] escapes attempt window [%d,%d] of %s" \
+                % (lo, hi, alo, ahi, tid)
+        assert seen == {f.trace_id for f in frs}, \
+            "some speculative request decoded without a verify window"
+
 
 # -- engine-level prefix cache (real model) -----------------------------------
 @pytest.fixture(scope="module")

@@ -95,6 +95,35 @@ def test_log_buckets_rejects_bad_ranges():
         mx.log_buckets(1.0, 10.0, per_decade=0)
 
 
+def test_histogram_percentile_overflow_clamps_to_top_edge():
+    # every sample past the top finite bound: the percentile rank lands
+    # in the +Inf overflow bucket. The histogram must answer with the
+    # top finite edge (honest lower bound, same convention as the
+    # telemetry-side _bucket_percentile) — NOT extrapolate toward max,
+    # which used to report a fabricated value between top edge and max.
+    h = mx.histogram("t/overflow", buckets=[1, 2, 4])
+    for v in (50.0, 400.0, 6000.0):
+        h.observe(v)
+    assert h.percentile(50) == 4.0
+    assert h.percentile(99) == 4.0
+    assert h._overflow_warned  # one-time vlog fired
+
+    # mixed population: ranks inside finite buckets are untouched,
+    # only the overflow tail clamps
+    m = mx.histogram("t/overflow_mixed", buckets=[1, 2, 4])
+    for v in (0.5, 0.6, 0.7, 1000.0):
+        m.observe(v)
+    assert m.percentile(50) <= 1.0
+    assert m.percentile(99) == 4.0
+
+    # reset() re-arms the one-time warning with the rest of the state
+    h.reset()
+    assert not h._overflow_warned
+    h.observe(99.0)
+    assert h.percentile(99) == 4.0
+    assert h._overflow_warned
+
+
 def test_log_bucketed_histogram_counts():
     h = mx.histogram("t/log_hist",
                      buckets=mx.log_buckets(1e-2, 1e2, per_decade=1))

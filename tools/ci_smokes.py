@@ -36,6 +36,7 @@ GATES = (
     ("fleet_bench", "tools.fleet_bench"),
     ("chaos_drill", "tools.chaos_drill"),
     ("fleet_trace", "tools.fleet_trace"),
+    ("fleet_autopsy", "tools.fleet_autopsy"),
     ("autotune", "tools.autotune"),
     ("check_budgets", "tools.check_budgets"),
     ("perf_gate", "tools.perf_gate"),
@@ -55,6 +56,7 @@ BUDGETS = {
     "fleet_bench": 75.0,  # + disagg QPS, remote-hit, and kill-migration legs
     "chaos_drill": 30.0,
     "fleet_trace": 10.0,
+    "fleet_autopsy": 10.0,
     "autotune": 15.0,
     "check_budgets": 10.0,
     "perf_gate": 10.0,

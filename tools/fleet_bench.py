@@ -801,6 +801,16 @@ def main(argv=None) -> int:
         res.update(runlog.tail_info())
     except Exception as e:
         res["run_ledger_error"] = repr(e)[:80]
+    # armed observability artifact pointers ride the END of the summary
+    # (truncation-proof tail, same contract as serve_bench's trace_file/
+    # telemetry_dir keys): a reader with only the last lines of a long
+    # log still knows where the trace and the event journal landed
+    trace_base = (os.environ.get("PADDLE_TPU_FLEET_TRACE_DIR") or "").strip()
+    if trace_base:
+        res["trace_dir"] = trace_base
+    event_log = (os.environ.get("PADDLE_TPU_FLEET_EVENTS") or "").strip()
+    if event_log:
+        res["event_log"] = event_log
     print(json.dumps(res, indent=1, default=str))
     return 0
 

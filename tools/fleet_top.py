@@ -10,7 +10,10 @@
         ``--watch`` redraws every SECONDS (default 2.0) until ^C;
         ``--ticks`` bounds the redraws (for drivers/tests). One replica
         per row, in NUMERIC index order (replica_10 after replica_9),
-        with ring freshness and degradation flags inline.
+        with ring freshness and degradation flags inline. When the run
+        was traced, each replica row carries a ``phases:`` sub-line with
+        the per-phase p50/p99 latency budgets (the ``fleet/phase/*``
+        decomposition the router folds into the snapshot at close).
 
     python -m tools.fleet_top --selftest
         <10s: drives a tiny process-mode sim fleet with telemetry + an
@@ -116,6 +119,11 @@ def render(view: dict) -> str:
                         "%.2f" % qps if isinstance(qps, float) else qps,
                         "%.1f" % p99 if isinstance(p99, float) else p99,
                         _ring_row(ring)))
+        ph = r.get("phases") or {}
+        cells = " ".join("%s %.1f/%.1f" % (p, st["p50_ms"], st["p99_ms"])
+                         for p, st in ph.items() if st.get("count"))
+        if cells:
+            lines.append("  phases(p50/p99 ms): %s" % cells)
     for ev in view.get("events") or []:
         extra = ev.get("replica")
         lines.append("event %-14s %s%s"
@@ -159,6 +167,7 @@ def selftest() -> int:
             engine_spec={"engine": "sim",
                          "sim": {"slots": 2, "step_ms": 2.0}},
             telemetry_base=base, event_log=elog,
+            trace_dir=os.path.join(td, "trace"),
             slos=[]))
         try:
             for i in range(6):
@@ -175,6 +184,10 @@ def selftest() -> int:
         assert "replica-0" in out and "replica-1" in out, out
         assert "finished=6" in out, out
         assert "fleet_stop" in out or "event" in out, out
+        # traced run -> the close-time snapshot carries per-replica phase
+        # budgets and the rows grow a phases sub-line
+        assert "phases(p50/p99 ms):" in out, out
+        assert "decode" in out and "prefill" in out, out
 
         # numeric ordering: a fabricated replica_10 ring must sort after
         # replica_2, not between replica_1 and replica_2
